@@ -1,0 +1,34 @@
+"""combblas_tpu — a TPU-native distributed sparse linear-algebra and
+graph-analytics framework with the capabilities of CombBLAS.
+
+Layer map (mirrors SURVEY.md §1, re-designed for JAX/XLA):
+
+* ``semiring``   — trace-time semiring protocol (≈ Semirings.h functors).
+* ``ops``        — local (single-tile) kernels on padded static-shape sparse
+                   tiles: tuples/CSR/CSC formats, segment reductions, SpMV,
+                   SpMSpV, SpGEMM, merge (≈ the sequential layer: dcsc/
+                   SpDCCols/Friends/mtSpGEMM/MultiwayMerge/SpImpl).
+* ``parallel``   — device-mesh grid, distributed matrices/vectors and the
+                   SUMMA/SpMV/3D collective schedules (≈ CommGrid, SpParMat,
+                   FullyDist*, ParFriends) expressed with shard_map +
+                   psum/all_gather/ppermute/all_to_all over ICI.
+* ``models``     — the application suite (BFS, CC, TC, PageRank, SSSP, MCL,
+                   BC, MIS, matchings, RCM ≈ Applications/).
+* ``utils``      — I/O (Matrix Market, Graph500 R-MAT generator),
+                   profiling timers, checkpointing.
+"""
+
+from .semiring import (
+    MAX_MIN,
+    MIN_PLUS,
+    OR_AND,
+    PLUS_TIMES,
+    SELECT2ND_MAX,
+    SELECT2ND_MIN,
+    STANDARD_SEMIRINGS,
+    Semiring,
+)
+from .ops.tuples import SpTuples
+from .ops.compressed import CSC, CSR
+
+__version__ = "0.1.0"

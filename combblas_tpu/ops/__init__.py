@@ -1,0 +1,4 @@
+from .compressed import CSC, CSR
+from .segment import expand_ranges, segment_reduce
+from .spmv import spmspv, spmv, spmv_masked
+from .tuples import SpTuples

@@ -1,0 +1,131 @@
+"""Compressed local tile formats (CSR / CSC) built on sorted tuples.
+
+The reference's workhorse local format is DCSC (doubly-compressed sparse
+column, ``include/CombBLAS/dcsc.h:46-135``) chosen because hypersparse tiles
+on large process grids have far fewer nonempty columns than columns.  On TPU
+the trade-off flips: gathers/scatters over a static-capacity index array are
+cheap and column-pointer *compression* buys nothing once shapes must be
+static — so the native analogs are:
+
+* ``CSR``: row-pointer array ``indptr[nrows+1]`` + column/value slot arrays.
+  Plays the role of ``SpDCCols`` for row-wise access (SpMV, SpGEMM B-side
+  row lookup).
+* ``CSC``: symmetric for column-wise access (SpMSpV column walks, SpGEMM
+  A-side).
+
+Both keep the padded-slot invariant of ``SpTuples`` (entries beyond ``nnz``
+hold out-of-range indices) and carry static ``nrows/ncols/capacity``.
+Hypersparsity is instead handled where it matters on TPU: capacities are per
+-tile trace-time constants, so an almost-empty tile compiles to almost-no
+work.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from ..semiring import Semiring
+from .segment import segment_reduce
+from .tuples import SpTuples
+
+Array = jax.Array
+
+
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=["indptr", "indices", "vals", "nnz"],
+    meta_fields=["nrows", "ncols"],
+)
+@dataclasses.dataclass(frozen=True)
+class CSR:
+    """Row-compressed tile. ``indices`` are column ids, row-major sorted."""
+
+    indptr: Array  # int32[nrows + 1]
+    indices: Array  # int32[cap]; padding = ncols
+    vals: Array  # NT[cap]
+    nnz: Array  # int32 scalar
+    nrows: int
+    ncols: int
+
+    @property
+    def capacity(self) -> int:
+        return self.indices.shape[0]
+
+    @staticmethod
+    def from_tuples(t: SpTuples, *, assume_sorted: bool = False) -> "CSR":
+        if not assume_sorted:
+            t = t.sort_rowmajor()
+        counts = jax.ops.segment_sum(
+            jnp.ones_like(t.rows), t.rows, num_segments=t.nrows
+        )
+        indptr = jnp.concatenate(
+            [jnp.zeros((1,), jnp.int32), jnp.cumsum(counts, dtype=jnp.int32)]
+        )
+        return CSR(
+            indptr=indptr, indices=t.cols, vals=t.vals, nnz=t.nnz,
+            nrows=t.nrows, ncols=t.ncols,
+        )
+
+    def row_lens(self) -> Array:
+        return self.indptr[1:] - self.indptr[:-1]
+
+    def to_tuples(self) -> SpTuples:
+        slot = jnp.arange(self.capacity, dtype=jnp.int32)
+        rows = jnp.searchsorted(self.indptr, slot, side="right").astype(jnp.int32) - 1
+        rows = jnp.where(slot < self.nnz, rows, self.nrows)
+        return SpTuples(
+            rows=rows, cols=self.indices, vals=self.vals, nnz=self.nnz,
+            nrows=self.nrows, ncols=self.ncols,
+        )
+
+
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=["indptr", "indices", "vals", "nnz"],
+    meta_fields=["nrows", "ncols"],
+)
+@dataclasses.dataclass(frozen=True)
+class CSC:
+    """Column-compressed tile. ``indices`` are row ids, col-major sorted."""
+
+    indptr: Array  # int32[ncols + 1]
+    indices: Array  # int32[cap]; padding = nrows
+    vals: Array
+    nnz: Array
+    nrows: int
+    ncols: int
+
+    @property
+    def capacity(self) -> int:
+        return self.indices.shape[0]
+
+    @staticmethod
+    def from_tuples(t: SpTuples, *, assume_sorted: bool = False) -> "CSC":
+        if not assume_sorted:
+            t = t.sort_colmajor()
+        counts = jax.ops.segment_sum(
+            jnp.ones_like(t.cols), t.cols, num_segments=t.ncols
+        )
+        indptr = jnp.concatenate(
+            [jnp.zeros((1,), jnp.int32), jnp.cumsum(counts, dtype=jnp.int32)]
+        )
+        return CSC(
+            indptr=indptr, indices=t.rows, vals=t.vals, nnz=t.nnz,
+            nrows=t.nrows, ncols=t.ncols,
+        )
+
+    def col_lens(self) -> Array:
+        return self.indptr[1:] - self.indptr[:-1]
+
+    def to_tuples(self) -> SpTuples:
+        slot = jnp.arange(self.capacity, dtype=jnp.int32)
+        cols = jnp.searchsorted(self.indptr, slot, side="right").astype(jnp.int32) - 1
+        cols = jnp.where(slot < self.nnz, cols, self.ncols)
+        return SpTuples(
+            rows=self.indices, cols=cols, vals=self.vals, nnz=self.nnz,
+            nrows=self.nrows, ncols=self.ncols,
+        )

@@ -1,0 +1,123 @@
+"""Local (single-tile) semiring mat-vec kernels.
+
+TPU-native counterparts of the reference's sequential kernel layer:
+
+* ``spmv``          ≈ ``dcsc_gespmv`` / ``dcsc_gespmv_threaded``
+                      (``include/CombBLAS/Friends.h:64-180``) — dense x.
+* ``spmspv``        ≈ ``SpImpl::SpMXSpV`` heap/bucket kernels
+                      (``include/CombBLAS/SpImpl.h:47-200``, ``SpImpl.cpp``)
+                      — sparse x, sparse y out.
+* ``spmv_masked``   ≈ the Graph500 fused path (``BFSFriends.h:59-182``) where
+                      already-visited rows are excluded before the reduction.
+
+Design note: the reference parallelizes these with OpenMP row-splits; here
+each kernel is a flat gather → elementwise ``mul`` → segment ``add`` chain
+that XLA fuses and vectorizes over the 8×128 VPU lanes. Padding slots carry
+out-of-range indices and are dropped by the scatter, so no masks are needed
+on the hot path.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..semiring import Semiring
+from .compressed import CSC
+from .segment import expand_ranges, segment_reduce
+from .tuples import SpTuples
+
+Array = jax.Array
+
+
+def spmv(sr: Semiring, a: SpTuples, x: Array) -> Array:
+    """Dense-vector semiring SpMV: ``y[i] = ⊕_j a[i,j] ⊗ x[j]``.
+
+    ``x`` must have shape [ncols]; returns [nrows]. Rows with no valid
+    entries get ``sr.zero``.
+    """
+    assert x.shape == (a.ncols,), (x.shape, a.ncols)
+    zero = sr.zero(x.dtype)
+    x_pad = jnp.concatenate([x, zero[None]])
+    prods = sr.mul(a.vals, x_pad[a.cols])
+    return segment_reduce(sr, prods, a.rows, a.nrows)
+
+
+def spmv_masked(sr: Semiring, a: SpTuples, x: Array, row_active: Array) -> Array:
+    """SpMV that suppresses output rows where ``row_active`` is False.
+
+    The suppressed rows get ``sr.zero``; this is the local analog of the
+    reference's fused BFS kernel which skips already-discovered vertices
+    (``BFSFriends.h:59-182`` BitMap dedup).
+    """
+    y = spmv(sr, a, x)
+    return jnp.where(row_active, y, sr.zero(y.dtype))
+
+
+def spmspv(
+    sr: Semiring,
+    a_csc: CSC,
+    x_ind: Array,
+    x_val: Array,
+    x_nnz: Array,
+    *,
+    out_capacity: int,
+) -> tuple[Array, Array, Array]:
+    """Sparse-vector semiring SpMSpV over a CSC tile.
+
+    Args:
+      x_ind: int32[xcap] active column ids (padding >= ncols).
+      x_val: values aligned with x_ind.
+      x_nnz: dynamic count of valid x entries.
+      out_capacity: static bound on distinct output rows (<= nrows).
+
+    Returns (y_ind, y_val, y_nnz): compacted sparse output, row-sorted.
+
+    Mirrors ``SpImpl::SpMXSpV_Bucket`` (SpImpl.cpp:390-600) but replaces the
+    two-phase bucket routing with expand (column walks flattened to static
+    slots) → semiring combine by destination row → compaction.
+    """
+    xcap = x_ind.shape[0]
+    slotmask = jnp.arange(xcap, dtype=jnp.int32) < x_nnz
+    x_ind = jnp.where(slotmask, x_ind, a_csc.ncols)
+    # Column lengths for each active x entry (0 for padding).
+    lens_pad = jnp.concatenate([a_csc.col_lens(), jnp.zeros((1,), jnp.int32)])
+    starts_pad = jnp.concatenate([a_csc.indptr[:-1], jnp.zeros((1,), jnp.int32)])
+    xlens = lens_pad[jnp.minimum(x_ind, a_csc.ncols)]
+    # Expansion capacity: every valid A entry can be touched at most once per
+    # distinct active column, bounded by the tile capacity.
+    exp_cap = a_csc.capacity
+    owner, offset, valid, _total = expand_ranges(xlens, exp_cap)
+    src_col_start = starts_pad[jnp.minimum(x_ind[owner], a_csc.ncols)]
+    slot = src_col_start + offset
+    row = jnp.where(valid, a_csc.indices[slot], a_csc.nrows)
+    prod = sr.mul(a_csc.vals[slot], x_val[owner])
+    y_dense = segment_reduce(sr, prod, row, a_csc.nrows)
+    # Compact nonzero (≠ semiring zero) entries.
+    zero = sr.zero(y_dense.dtype)
+    # Only rows actually touched count — but a touched row may legitimately
+    # hold the zero value only when sr.add produced it; CombBLAS stores it.
+    touched = (
+        jnp.zeros((a_csc.nrows,), jnp.int32)
+        .at[row]
+        .add(jnp.ones_like(row), mode="drop")
+        > 0
+    )
+    keep = touched
+    pos = jnp.cumsum(keep.astype(jnp.int32)) - 1
+    scatter_idx = jnp.where(keep, pos, out_capacity)
+    all_rows = jnp.arange(a_csc.nrows, dtype=jnp.int32)
+    y_ind = (
+        jnp.full((out_capacity,), a_csc.nrows, jnp.int32)
+        .at[scatter_idx]
+        .set(all_rows, mode="drop")
+    )
+    y_val = (
+        jnp.full((out_capacity,), zero, y_dense.dtype)
+        .at[scatter_idx]
+        .set(y_dense, mode="drop")
+    )
+    # Clamp: rows beyond out_capacity were dropped by the scatter above, so
+    # the reported count must match what the buffers actually hold.
+    y_nnz = jnp.minimum(jnp.sum(keep).astype(jnp.int32), jnp.int32(out_capacity))
+    return y_ind, y_val, y_nnz

@@ -1,0 +1,283 @@
+"""SpTuples — padded static-capacity COO tile, the interchange format.
+
+Mirrors the role of the reference's ``SpTuples<IT,NT>``
+(``include/CombBLAS/SpTuples.h:64-120``): the column/row-sorted triple format
+every kernel, merge, redistribution, and I/O path speaks.  The TPU-native
+difference: XLA requires static shapes, so a tile carries a fixed ``capacity``
+of slots plus a dynamic ``nnz`` scalar.  Invalid (padding) slots hold
+``row == nrows, col == ncols`` so that
+
+* scatters drop them (out-of-range + ``mode='drop'``),
+* row-major / col-major sorts push them to the tail,
+* gathers hit a dedicated padded slot holding the semiring zero.
+
+All ops are jit-compatible; ``nrows/ncols/capacity`` are trace-time static.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..semiring import Semiring
+from .segment import segment_reduce
+
+Array = jax.Array
+
+
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=["rows", "cols", "vals", "nnz"],
+    meta_fields=["nrows", "ncols"],
+)
+@dataclasses.dataclass(frozen=True)
+class SpTuples:
+    """Padded COO tile. Valid entries occupy a prefix iff compacted.
+
+    rows/cols: int32[cap]; padding slots hold (nrows, ncols).
+    vals: NT[cap]; padding values are unspecified (protected by index drop).
+    nnz: int32 scalar — number of valid entries.
+    """
+
+    rows: Array
+    cols: Array
+    vals: Array
+    nnz: Array
+    nrows: int
+    ncols: int
+
+    @property
+    def capacity(self) -> int:
+        return self.rows.shape[0]
+
+    @property
+    def dtype(self):
+        return self.vals.dtype
+
+    # --- constructors -----------------------------------------------------
+
+    @staticmethod
+    def from_coo(rows, cols, vals, nrows, ncols, capacity=None) -> "SpTuples":
+        """Build from concrete (host) index/value arrays (unsorted ok)."""
+        rows = np.asarray(rows, dtype=np.int32)
+        cols = np.asarray(cols, dtype=np.int32)
+        vals = np.asarray(vals)
+        n = rows.shape[0]
+        cap = int(capacity) if capacity is not None else max(n, 1)
+        if n > cap:
+            raise ValueError(f"nnz {n} exceeds capacity {cap}")
+        pr = np.full(cap, nrows, dtype=np.int32)
+        pc = np.full(cap, ncols, dtype=np.int32)
+        pv = np.zeros(cap, dtype=vals.dtype)
+        pr[:n], pc[:n], pv[:n] = rows, cols, vals
+        return SpTuples(
+            rows=jnp.asarray(pr),
+            cols=jnp.asarray(pc),
+            vals=jnp.asarray(pv),
+            nnz=jnp.asarray(n, dtype=jnp.int32),
+            nrows=int(nrows),
+            ncols=int(ncols),
+        )
+
+    @staticmethod
+    def from_dense(dense, capacity=None, zero=0) -> "SpTuples":
+        """Host-side convenience (tests / small inputs)."""
+        dense = np.asarray(dense)
+        r, c = np.nonzero(dense != zero)
+        return SpTuples.from_coo(
+            r, c, dense[r, c], dense.shape[0], dense.shape[1], capacity
+        )
+
+    @staticmethod
+    def empty(nrows, ncols, capacity, dtype) -> "SpTuples":
+        return SpTuples(
+            rows=jnp.full((capacity,), nrows, dtype=jnp.int32),
+            cols=jnp.full((capacity,), ncols, dtype=jnp.int32),
+            vals=jnp.zeros((capacity,), dtype=dtype),
+            nnz=jnp.asarray(0, dtype=jnp.int32),
+            nrows=int(nrows),
+            ncols=int(ncols),
+        )
+
+    # --- basic queries ----------------------------------------------------
+
+    def valid_mask(self) -> Array:
+        return self.rows < self.nrows
+
+    def to_dense(self, sr: Semiring = None) -> Array:
+        """Densify; duplicates are combined with ``sr.add`` (default: sum)."""
+        zero = sr.zero(self.dtype) if sr is not None else jnp.zeros((), self.dtype)
+        out = jnp.full((self.nrows + 1, self.ncols + 1), zero, dtype=self.dtype)
+        if sr is None or sr.add_kind == "sum":
+            out = out.at[self.rows, self.cols].add(
+                jnp.where(self.valid_mask(), self.vals, 0), mode="drop"
+            )
+        elif sr.add_kind == "min":
+            out = out.at[self.rows, self.cols].min(self.vals, mode="drop")
+        elif sr.add_kind == "max":
+            out = out.at[self.rows, self.cols].max(self.vals, mode="drop")
+        else:
+            # Generic monoid: flatten (row, col) to one segment id and run the
+            # order-respecting segmented reduction (scatter .set would be
+            # last-write-wins with unspecified order).
+            flat_ids = self.rows * (self.ncols + 1) + self.cols
+            flat = segment_reduce(
+                sr, self.vals, flat_ids, (self.nrows + 1) * (self.ncols + 1)
+            )
+            out = flat.reshape(self.nrows + 1, self.ncols + 1)
+        return out[: self.nrows, : self.ncols]
+
+    # --- structural transforms -------------------------------------------
+
+    def sort_rowmajor(self) -> "SpTuples":
+        r, c, v = lax.sort((self.rows, self.cols, self.vals), num_keys=2)
+        return dataclasses.replace(self, rows=r, cols=c, vals=v)
+
+    def sort_colmajor(self) -> "SpTuples":
+        c, r, v = lax.sort((self.cols, self.rows, self.vals), num_keys=2)
+        return dataclasses.replace(self, rows=r, cols=c, vals=v)
+
+    def transpose(self) -> "SpTuples":
+        """Swap rows/cols. Reference: ``SpTuples`` transpose ctor flag."""
+        return SpTuples(
+            rows=jnp.where(self.valid_mask(), self.cols, self.ncols),
+            cols=jnp.where(self.valid_mask(), self.rows, self.nrows),
+            vals=self.vals,
+            nnz=self.nnz,
+            nrows=self.ncols,
+            ncols=self.nrows,
+        )
+
+    def with_capacity(self, capacity: int) -> "SpTuples":
+        """Grow/shrink the slot count.
+
+        Shrinking requires a compacted tile with ``nnz <= capacity``; entries
+        beyond the new capacity are lost and ``nnz`` is clamped to match.
+        """
+        cap = self.capacity
+        if capacity == cap:
+            return self
+        if capacity > cap:
+            pad = capacity - cap
+            return dataclasses.replace(
+                self,
+                rows=jnp.concatenate(
+                    [self.rows, jnp.full((pad,), self.nrows, jnp.int32)]
+                ),
+                cols=jnp.concatenate(
+                    [self.cols, jnp.full((pad,), self.ncols, jnp.int32)]
+                ),
+                vals=jnp.concatenate(
+                    [self.vals, jnp.zeros((pad,), self.vals.dtype)]
+                ),
+            )
+        return dataclasses.replace(
+            self,
+            rows=self.rows[:capacity],
+            cols=self.cols[:capacity],
+            vals=self.vals[:capacity],
+            nnz=jnp.minimum(self.nnz, jnp.int32(capacity)),
+        )
+
+    def compact(self, sr: Semiring, *, capacity: int | None = None) -> "SpTuples":
+        """Sort row-major, combine duplicates with ``sr.add``, drop explicit
+        zeros, and pack valid entries to the front.
+
+        Mirrors ``SpTuples::RemoveDuplicates(BinOp)`` (SpTuples.h:89) plus the
+        sort that every DCSC build performs.
+
+        INVARIANT: ``capacity`` must be >= the number of distinct (row, col)
+        keys; entries whose combined slot lands beyond it are truncated (the
+        static-shape price of XLA — callers size capacities from symbolic
+        estimates, see ops/spgemm.py). ``nnz`` is clamped to ``capacity`` so
+        the result stays self-consistent either way.
+        """
+        cap = capacity if capacity is not None else self.capacity
+        t = self.sort_rowmajor()
+        valid = t.valid_mask()
+        prev_same = jnp.concatenate(
+            [
+                jnp.zeros((1,), bool),
+                (t.rows[1:] == t.rows[:-1]) & (t.cols[1:] == t.cols[:-1]),
+            ]
+        )
+        is_new = valid & ~prev_same
+        seg = jnp.cumsum(is_new.astype(jnp.int32)) - 1
+        seg = jnp.where(valid, seg, cap)
+        vals = segment_reduce(sr, t.vals, seg, cap, ids_sorted=True)
+        scatter_idx = jnp.where(is_new, seg, cap)
+        rows = jnp.full((cap,), self.nrows, jnp.int32).at[scatter_idx].set(
+            t.rows, mode="drop"
+        )
+        cols = jnp.full((cap,), self.ncols, jnp.int32).at[scatter_idx].set(
+            t.cols, mode="drop"
+        )
+        nnz = jnp.minimum(jnp.sum(is_new).astype(jnp.int32), jnp.int32(cap))
+        out = SpTuples(
+            rows=rows, cols=cols, vals=vals, nnz=nnz,
+            nrows=self.nrows, ncols=self.ncols,
+        )
+        return out.prune_zeros(sr)
+
+    def prune_zeros(self, sr: Semiring) -> "SpTuples":
+        """Drop entries equal to the additive identity (compacted output)."""
+        zero = sr.zero(self.dtype)
+        keep = self.valid_mask() & (self.vals != zero)
+        return self._select(keep)
+
+    def prune(self, pred) -> "SpTuples":
+        """Drop entries where ``pred(val)`` is True.
+
+        Reference: ``SpParMat::Prune`` (SpParMat.h:162-198) local part.
+        """
+        keep = self.valid_mask() & ~pred(self.vals)
+        return self._select(keep)
+
+    def _select(self, keep: Array) -> "SpTuples":
+        """Stable-compact entries where ``keep`` to the front."""
+        cap = self.capacity
+        pos = jnp.cumsum(keep.astype(jnp.int32)) - 1
+        scatter_idx = jnp.where(keep, pos, cap)
+        rows = jnp.full((cap,), self.nrows, jnp.int32).at[scatter_idx].set(
+            self.rows, mode="drop"
+        )
+        cols = jnp.full((cap,), self.ncols, jnp.int32).at[scatter_idx].set(
+            self.cols, mode="drop"
+        )
+        vals = jnp.zeros((cap,), self.vals.dtype).at[scatter_idx].set(
+            self.vals, mode="drop"
+        )
+        return SpTuples(
+            rows=rows, cols=cols, vals=vals,
+            nnz=jnp.sum(keep).astype(jnp.int32),
+            nrows=self.nrows, ncols=self.ncols,
+        )
+
+    def apply(self, fn) -> "SpTuples":
+        """Elementwise value transform on valid entries.
+
+        Reference: ``SpParMat::Apply`` (SpParMat.h:148).
+        """
+        vals = jnp.where(self.valid_mask(), fn(self.vals), self.vals)
+        return dataclasses.replace(self, vals=vals)
+
+    # --- concatenation (merge input) -------------------------------------
+
+    @staticmethod
+    def concat(tiles: list["SpTuples"]) -> "SpTuples":
+        """Stack slot arrays of same-shape tiles (pre-merge). All tiles must
+        share (nrows, ncols). Output capacity = sum of capacities."""
+        t0 = tiles[0]
+        return SpTuples(
+            rows=jnp.concatenate([t.rows for t in tiles]),
+            cols=jnp.concatenate([t.cols for t in tiles]),
+            vals=jnp.concatenate([t.vals for t in tiles]),
+            nnz=sum((t.nnz for t in tiles[1:]), start=t0.nnz),
+            nrows=t0.nrows,
+            ncols=t0.ncols,
+        )

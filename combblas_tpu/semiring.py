@@ -1,0 +1,161 @@
+"""Semirings as trace-time-specialized closures.
+
+The reference encodes semirings as compile-time C++ functor classes
+(``/root/reference/include/CombBLAS/Semirings.h:51-259``) so that one SpGEMM /
+SpMV implementation serves BFS, SSSP, MIS, triangle counting, MCL, etc.  The
+TPU-native analog is a frozen dataclass of jittable ``add`` / ``mul`` closures:
+JAX traces them once per (semiring, shape, dtype) combination, which plays the
+same role as template instantiation — zero runtime dispatch cost inside the
+compiled XLA program.
+
+``add_kind`` is a monoid hint that lets reductions ride XLA's native
+scatter-add / scatter-min / scatter-max and ``psum`` / ``pmin`` / ``pmax``
+collectives instead of a generic segmented scan (see ``ops/segment.py``).
+
+The reference's ``returnedSAID()`` "do not store" sentinel protocol
+(``Semirings.h:36-49``) is expressed here structurally: a ``mul`` may return
+the additive identity (``zero``), which is inert under ``add`` and is
+compacted away by ``SpTuples.compact`` — no sentinel flag needed because the
+padded static-shape representation already carries validity.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import jax.numpy as jnp
+import numpy as np
+
+# Monoid kinds with an XLA-native fast path.
+ADD_KINDS = ("sum", "min", "max", "generic")
+
+
+def _minval(dtype) -> Any:
+    dtype = jnp.dtype(dtype)
+    if jnp.issubdtype(dtype, jnp.floating):
+        return -jnp.inf
+    if dtype == jnp.bool_:
+        return False
+    return np.iinfo(dtype).min
+
+
+def _maxval(dtype) -> Any:
+    dtype = jnp.dtype(dtype)
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.inf
+    if dtype == jnp.bool_:
+        return True
+    return np.iinfo(dtype).max
+
+
+@dataclasses.dataclass(frozen=True)
+class Semiring:
+    """An algebraic semiring ``(add, zero) / (mul, one)``.
+
+    Attributes:
+      name: stable identifier (used for caching / debugging).
+      add: associative + commutative jittable binary op (the monoid).
+      mul: jittable binary op ``mul(a_val, x_val)``; must absorb ``zero`` in
+        its second argument (``mul(a, zero) == zero``) so that padded vector
+        slots stay inert.
+      zero_fn: dtype -> additive identity scalar.
+      one_fn: dtype -> multiplicative identity scalar (may be None).
+      add_kind: one of ``ADD_KINDS``; selects the XLA-native reduction path.
+    """
+
+    name: str
+    add: Callable[[Any, Any], Any]
+    mul: Callable[[Any, Any], Any]
+    zero_fn: Callable[[Any], Any]
+    one_fn: Callable[[Any], Any] | None = None
+    add_kind: str = "generic"
+
+    def zero(self, dtype) -> Any:
+        return jnp.asarray(self.zero_fn(dtype), dtype=dtype)
+
+    def one(self, dtype) -> Any:
+        if self.one_fn is None:
+            raise ValueError(f"semiring {self.name} has no multiplicative identity")
+        return jnp.asarray(self.one_fn(dtype), dtype=dtype)
+
+    # Semirings are static (trace-time) configuration: hash by name.
+    def __hash__(self):
+        return hash(("Semiring", self.name))
+
+    def __eq__(self, other):
+        return isinstance(other, Semiring) and other.name == self.name
+
+
+# --- The standard semiring zoo (reference: Semirings.h) -------------------
+
+#: Ordinary arithmetic (+, *): PageRank, BC, SpGEMM nnz structure, MCL.
+#: Reference: ``PlusTimesSRing`` (Semirings.h:213).
+PLUS_TIMES = Semiring(
+    name="plus_times",
+    add=lambda x, y: x + y,
+    mul=lambda a, x: a * x,
+    zero_fn=lambda dt: 0,
+    one_fn=lambda dt: 1,
+    add_kind="sum",
+)
+
+#: Tropical (min, +): SSSP / Bellman-Ford.
+#: Reference: ``MinPlusSRing`` (Semirings.h:236).
+MIN_PLUS = Semiring(
+    name="min_plus",
+    add=jnp.minimum,
+    mul=lambda a, x: a + x,
+    zero_fn=_maxval,
+    one_fn=lambda dt: 0,
+    add_kind="min",
+)
+
+#: (max, *): used by Graph500 BFS in the reference (``SelectMaxSRing``,
+#: Semirings.h:166): multiply returns the vector value (a parent id), add
+#: picks any one — max makes it deterministic.
+SELECT2ND_MAX = Semiring(
+    name="select2nd_max",
+    add=jnp.maximum,
+    mul=lambda a, x: x,
+    zero_fn=lambda dt: -1 if jnp.issubdtype(jnp.dtype(dt), jnp.integer) else _minval(dt),
+    one_fn=None,
+    add_kind="max",
+)
+
+#: (min, select2nd): FastSV / LACC connected components propagate the minimum
+#: label. Reference: ``Select2ndMinSR`` (CC.h, FastSV.h usage).
+SELECT2ND_MIN = Semiring(
+    name="select2nd_min",
+    add=jnp.minimum,
+    mul=lambda a, x: x,
+    zero_fn=_maxval,
+    one_fn=None,
+    add_kind="min",
+)
+
+#: Boolean (or, and): reachability / structure-only products.
+#: Reference: ``BoolCopy2ndSRing`` / bool specializations (Semirings.h:51-142).
+OR_AND = Semiring(
+    name="or_and",
+    add=jnp.logical_or,
+    mul=jnp.logical_and,
+    zero_fn=lambda dt: False,
+    one_fn=lambda dt: True,
+    add_kind="max",  # max == or on bool
+)
+
+#: (max, min): bottleneck / widest-path semiring.
+MAX_MIN = Semiring(
+    name="max_min",
+    add=jnp.maximum,
+    mul=jnp.minimum,
+    zero_fn=_minval,
+    one_fn=_maxval,
+    add_kind="max",
+)
+
+STANDARD_SEMIRINGS = {
+    sr.name: sr
+    for sr in (PLUS_TIMES, MIN_PLUS, SELECT2ND_MAX, SELECT2ND_MIN, OR_AND, MAX_MIN)
+}

@@ -1,0 +1,42 @@
+"""Test harness: force an 8-device virtual CPU mesh.
+
+Multi-chip logic (grids, collectives, shardings) is validated the way the
+reference validates multi-node logic with `mpirun -np {1,4,16}` on one host
+(SURVEY.md §4.4): XLA's host-platform device-count gives us 8 virtual CPU
+devices, so 2x4 / 4x2 / 8x1 meshes all run in-process.
+
+NOTE: the baked sitecustomize registers the axon TPU backend at interpreter
+startup, so JAX_PLATFORMS env alone is not enough — we also flip the config
+before any backend is initialized.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_default_matmul_precision", "highest")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _devices():
+    assert len(jax.devices()) == 8, jax.devices()
+    yield
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)
+
+
+def random_dense(rng, m, n, density=0.3, dtype=np.float32):
+    """Random dense matrix with ~density nonzeros (shared test helper)."""
+    d = rng.random((m, n)) * (rng.random((m, n)) < density)
+    return d.astype(dtype)

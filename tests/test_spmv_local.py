@@ -1,0 +1,113 @@
+"""Local semiring SpMV / SpMSpV kernels vs numpy references."""
+
+import numpy as np
+import pytest
+
+from combblas_tpu import MIN_PLUS, PLUS_TIMES, SELECT2ND_MAX, SpTuples
+from combblas_tpu.ops.compressed import CSC
+from combblas_tpu.ops.spmv import spmspv, spmv, spmv_masked
+from conftest import random_dense
+
+
+def test_spmv_plus_times(rng):
+    d = random_dense(rng, 17, 13)
+    x = rng.random(13).astype(np.float32)
+    t = SpTuples.from_dense(d, capacity=256)
+    y = spmv(PLUS_TIMES, t, x)
+    np.testing.assert_allclose(np.asarray(y), d @ x, rtol=1e-5)
+
+
+def test_spmv_min_plus(rng):
+    m, n = 9, 9
+    d = random_dense(rng, m, n, 0.4)
+    t = SpTuples.from_dense(d, capacity=100)
+    x = rng.random(n).astype(np.float32)
+    y = np.asarray(spmv(MIN_PLUS, t, x))
+    expect = np.full(m, np.inf, np.float32)
+    for i in range(m):
+        for j in range(n):
+            if d[i, j] != 0:
+                expect[i] = min(expect[i], d[i, j] + x[j])
+    np.testing.assert_allclose(y, expect, rtol=1e-6)
+
+
+def test_spmv_select2nd_max_bfs_style(rng):
+    # x carries candidate parent ids (or -1 = inactive); y[i] = max parent
+    # over in-neighbors, the Graph500 semiring (Semirings.h:166).
+    m, n = 8, 8
+    d = (random_dense(rng, m, n, 0.4) != 0).astype(np.int32)
+    t = SpTuples.from_dense(d, capacity=64)
+    x = np.where(rng.random(n) < 0.5, np.arange(n), -1).astype(np.int32)
+    y = np.asarray(spmv(SELECT2ND_MAX, t, x))
+    expect = np.full(m, -1, np.int32)
+    for i in range(m):
+        for j in range(n):
+            if d[i, j] and x[j] >= 0:
+                expect[i] = max(expect[i], x[j])
+    np.testing.assert_array_equal(y, expect)
+
+
+def test_spmv_masked(rng):
+    d = random_dense(rng, 10, 10)
+    t = SpTuples.from_dense(d, capacity=128)
+    x = rng.random(10).astype(np.float32)
+    active = rng.random(10) < 0.5
+    y = np.asarray(spmv_masked(PLUS_TIMES, t, x, active))
+    np.testing.assert_allclose(y, np.where(active, d @ x, 0), rtol=1e-5)
+
+
+def test_spmspv_plus_times(rng):
+    m, n = 15, 12
+    d = random_dense(rng, m, n, 0.3)
+    t = SpTuples.from_dense(d, capacity=256)
+    csc = CSC.from_tuples(t)
+    # sparse x with 4 active entries
+    active = rng.choice(n, size=4, replace=False)
+    xcap = 8
+    x_ind = np.full(xcap, n, np.int32)
+    x_val = np.zeros(xcap, np.float32)
+    x_ind[:4] = np.sort(active)
+    x_val[:4] = rng.random(4)
+    y_ind, y_val, y_nnz = spmspv(
+        PLUS_TIMES, csc,
+        np.asarray(x_ind), np.asarray(x_val), np.int32(4),
+        out_capacity=m,
+    )
+    x_dense = np.zeros(n, np.float32)
+    x_dense[x_ind[:4]] = x_val[:4]
+    expect = d @ x_dense
+    got = np.zeros(m, np.float32)
+    k = int(y_nnz)
+    got[np.asarray(y_ind)[:k]] = np.asarray(y_val)[:k]
+    np.testing.assert_allclose(got, np.where(np.abs(expect) > 0, expect, 0), rtol=1e-5)
+    # output rows = rows structurally touched
+    touched = np.unique(np.nonzero(d[:, x_ind[:4]])[0])
+    np.testing.assert_array_equal(np.sort(np.asarray(y_ind)[:k]), touched)
+
+
+def test_spmspv_select2nd_max(rng):
+    # BFS step shape: bool matrix, x holds parent ids.
+    m = n = 10
+    d = (random_dense(rng, m, n, 0.3) != 0).astype(np.int32)
+    t = SpTuples.from_dense(d, capacity=128)
+    csc = CSC.from_tuples(t)
+    frontier = rng.choice(n, size=3, replace=False)
+    xcap = 6
+    x_ind = np.full(xcap, n, np.int32)
+    x_val = np.full(xcap, -1, np.int32)
+    x_ind[:3] = np.sort(frontier)
+    x_val[:3] = x_ind[:3]  # parent = self id
+    y_ind, y_val, y_nnz = spmspv(
+        SELECT2ND_MAX, csc,
+        np.asarray(x_ind), np.asarray(x_val), np.int32(3),
+        out_capacity=m,
+    )
+    expect = np.full(m, -1, np.int32)
+    for j in frontier:
+        for i in range(m):
+            if d[i, j]:
+                expect[i] = max(expect[i], j)
+    k = int(y_nnz)
+    got = np.full(m, -1, np.int32)
+    got[np.asarray(y_ind)[:k]] = np.asarray(y_val)[:k]
+    np.testing.assert_array_equal(got, expect)

@@ -1,0 +1,105 @@
+"""Unit tests for the padded COO tile (SpTuples) vs dense numpy references.
+
+The reference has no unit tests (SURVEY.md §4) — this is the deterministic
+seeded layer it lacks.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from combblas_tpu import MIN_PLUS, PLUS_TIMES, SELECT2ND_MAX, SpTuples
+from combblas_tpu.ops.compressed import CSC, CSR
+from conftest import random_dense
+
+
+def test_roundtrip_dense(rng):
+    d = random_dense(rng, 13, 7)
+    t = SpTuples.from_dense(d, capacity=d.size)
+    np.testing.assert_array_equal(np.asarray(t.to_dense()), d)
+    assert int(t.nnz) == np.count_nonzero(d)
+
+
+def test_sort_and_padding_at_tail(rng):
+    d = random_dense(rng, 9, 11)
+    t = SpTuples.from_dense(d, capacity=120)
+    # scramble order
+    perm = rng.permutation(120)
+    t2 = SpTuples(
+        rows=t.rows[perm], cols=t.cols[perm], vals=t.vals[perm],
+        nnz=t.nnz, nrows=t.nrows, ncols=t.ncols,
+    )
+    s = t2.sort_rowmajor()
+    n = int(s.nnz)
+    rows = np.asarray(s.rows)
+    assert np.all(rows[:n] < 9)
+    assert np.all(rows[n:] == 9)
+    np.testing.assert_array_equal(np.asarray(s.to_dense()), d)
+
+
+def test_transpose(rng):
+    d = random_dense(rng, 5, 8)
+    t = SpTuples.from_dense(d, capacity=50)
+    np.testing.assert_array_equal(np.asarray(t.transpose().to_dense()), d.T)
+
+
+def test_compact_merges_duplicates():
+    rows = [0, 2, 0, 1, 0]
+    cols = [1, 3, 1, 1, 1]
+    vals = [1.0, 5.0, 2.0, 3.0, 4.0]
+    t = SpTuples.from_coo(rows, cols, vals, 4, 4, capacity=12)
+    c = t.compact(PLUS_TIMES)
+    dense = np.zeros((4, 4), np.float32)
+    dense[0, 1] = 7.0
+    dense[2, 3] = 5.0
+    dense[1, 1] = 3.0
+    np.testing.assert_array_equal(np.asarray(c.to_dense()), dense)
+    assert int(c.nnz) == 3
+    # compacted: valid prefix
+    assert np.all(np.asarray(c.rows)[3:] == 4)
+
+
+def test_compact_min_semiring():
+    t = SpTuples.from_coo([0, 0], [1, 1], [5.0, 2.0], 2, 2, capacity=4)
+    c = t.compact(MIN_PLUS)
+    assert np.asarray(c.to_dense(MIN_PLUS))[0, 1] == 2.0
+
+
+def test_prune_and_apply(rng):
+    d = random_dense(rng, 10, 10)
+    t = SpTuples.from_dense(d, capacity=128)
+    p = t.prune(lambda v: v > 0.5)
+    expect = np.where(d > 0.5, 0, d)
+    np.testing.assert_array_equal(np.asarray(p.to_dense()), expect)
+    a = t.apply(lambda v: v * 2)
+    np.testing.assert_allclose(np.asarray(a.to_dense()), d * 2, rtol=1e-6)
+
+
+def test_concat_compact(rng):
+    d1 = random_dense(rng, 6, 6)
+    d2 = random_dense(rng, 6, 6)
+    t = SpTuples.concat(
+        [SpTuples.from_dense(d1, capacity=40), SpTuples.from_dense(d2, capacity=40)]
+    )
+    c = t.compact(PLUS_TIMES)
+    np.testing.assert_allclose(np.asarray(c.to_dense()), d1 + d2, rtol=1e-6)
+
+
+def test_csr_csc_roundtrip(rng):
+    d = random_dense(rng, 12, 9)
+    t = SpTuples.from_dense(d, capacity=128)
+    csr = CSR.from_tuples(t)
+    np.testing.assert_array_equal(np.asarray(csr.to_tuples().to_dense()), d)
+    lens = np.asarray(csr.row_lens())
+    np.testing.assert_array_equal(lens, (d != 0).sum(axis=1))
+    csc = CSC.from_tuples(t)
+    np.testing.assert_array_equal(np.asarray(csc.to_tuples().to_dense()), d)
+    np.testing.assert_array_equal(np.asarray(csc.col_lens()), (d != 0).sum(axis=0))
+
+
+def test_empty_tile():
+    t = SpTuples.empty(4, 4, 8, jnp.float32)
+    assert int(t.nnz) == 0
+    np.testing.assert_array_equal(np.asarray(t.to_dense()), np.zeros((4, 4)))
+    c = t.compact(PLUS_TIMES)
+    assert int(c.nnz) == 0
