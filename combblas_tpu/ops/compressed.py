@@ -29,7 +29,7 @@ import jax
 import jax.numpy as jnp
 
 from ..semiring import Semiring
-from .segment import segment_reduce
+from .segment import expand_ranges, segment_reduce
 from .tuples import SpTuples
 
 Array = jax.Array
@@ -74,9 +74,8 @@ class CSR:
         return self.indptr[1:] - self.indptr[:-1]
 
     def to_tuples(self) -> SpTuples:
-        slot = jnp.arange(self.capacity, dtype=jnp.int32)
-        rows = jnp.searchsorted(self.indptr, slot, side="right").astype(jnp.int32) - 1
-        rows = jnp.where(slot < self.nnz, rows, self.nrows)
+        owner, _, valid, _ = expand_ranges(self.row_lens(), self.capacity)
+        rows = jnp.where(valid, owner, self.nrows)
         return SpTuples(
             rows=rows, cols=self.indices, vals=self.vals, nnz=self.nnz,
             nrows=self.nrows, ncols=self.ncols,
@@ -122,9 +121,8 @@ class CSC:
         return self.indptr[1:] - self.indptr[:-1]
 
     def to_tuples(self) -> SpTuples:
-        slot = jnp.arange(self.capacity, dtype=jnp.int32)
-        cols = jnp.searchsorted(self.indptr, slot, side="right").astype(jnp.int32) - 1
-        cols = jnp.where(slot < self.nnz, cols, self.ncols)
+        owner, _, valid, _ = expand_ranges(self.col_lens(), self.capacity)
+        cols = jnp.where(valid, owner, self.ncols)
         return SpTuples(
             rows=self.indices, cols=cols, vals=self.vals, nnz=self.nnz,
             nrows=self.nrows, ncols=self.ncols,

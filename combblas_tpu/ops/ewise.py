@@ -1,0 +1,79 @@
+"""Elementwise set operations on sparse tiles via sort-merge.
+
+The reference implements ``EWiseMult`` / ``EWiseApply`` with synchronized
+column-pointer walks over two DCSC structures
+(``include/CombBLAS/ParFriends.h:2157-2807``, ``Friends.h``).  The TPU-native
+equivalent: concatenate both tiles' keys, lexicographic ``lax.sort``, and
+detect matches by adjacency — O((nnzA+nnzB) log) fully vectorized work with
+no data-dependent control flow, which XLA maps onto the TPU's native sort.
+
+Avoids composite int64 keys on purpose: tile dims can make row*ncols+col
+overflow int32, and int64 is off by default in JAX — multi-key sort + tag
+ordering gives exact lexicographic semantics in pure int32.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .tuples import SpTuples
+
+Array = jax.Array
+
+
+def intersect_lookup(a: SpTuples, b: SpTuples, b_zero) -> tuple[Array, Array]:
+    """For every slot of ``a``: is (row, col) present in ``b``, and b's value.
+
+    Returns (hit[capA] bool, bval[capA]); misses get ``b_zero``.  ``b`` must
+    be duplicate-free among valid entries (compacted).  Hits reported on
+    padding slots of ``a`` are meaningless — callers must mask with
+    ``a.valid_mask()``.
+    """
+    capa, capb = a.capacity, b.capacity
+    rows = jnp.concatenate([b.rows, a.rows])
+    cols = jnp.concatenate([b.cols, a.cols])
+    # tag sorts b-entries immediately before a-entries with the same key
+    tag = jnp.concatenate(
+        [jnp.zeros((capb,), jnp.int32), jnp.ones((capa,), jnp.int32)]
+    )
+    bval = jnp.concatenate([b.vals, jnp.zeros((capa,), b.vals.dtype)])
+    apos = jnp.concatenate(
+        [jnp.full((capb,), capa, jnp.int32), jnp.arange(capa, dtype=jnp.int32)]
+    )
+    r, c, t, bv, ap = lax.sort((rows, cols, tag, bval, apos), num_keys=3)
+    matched = (
+        (r[1:] == r[:-1]) & (c[1:] == c[:-1]) & (t[1:] == 1) & (t[:-1] == 0)
+    )
+    hit_sorted = jnp.concatenate([jnp.zeros((1,), bool), matched])
+    bv_prev = jnp.concatenate([bv[:1], bv[:-1]])
+    scatter_idx = jnp.where(t == 1, ap, capa)
+    hit = (
+        jnp.zeros((capa,), bool).at[scatter_idx].set(hit_sorted, mode="drop")
+    )
+    bvals = (
+        jnp.full((capa,), b_zero, dtype=b.vals.dtype)
+        .at[scatter_idx]
+        .set(jnp.where(hit_sorted, bv_prev, b_zero), mode="drop")
+    )
+    return hit, bvals
+
+
+def ewise_mult(a: SpTuples, b: SpTuples, negate: bool, combine=None) -> SpTuples:
+    """A .* structure(B) (negate=False) or A .* ¬structure(B) (negate=True).
+
+    ``combine(a_val, b_val)`` transforms kept values when intersecting
+    (default keeps a's value — the reference's exclude=false semantics).
+    Reference: ``EWiseMult`` (ParFriends.h:2157-2244).
+    """
+    hit, bvals = intersect_lookup(a, b, b_zero=jnp.zeros((), b.vals.dtype))
+    keep = a.valid_mask() & (hit != negate)
+    out = a
+    if combine is not None and not negate:
+        out = SpTuples(
+            rows=a.rows, cols=a.cols,
+            vals=jnp.where(keep, combine(a.vals, bvals), a.vals),
+            nnz=a.nnz, nrows=a.nrows, ncols=a.ncols,
+        )
+    return out._select(keep)

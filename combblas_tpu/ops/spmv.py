@@ -66,9 +66,13 @@ def spmspv(
     """Sparse-vector semiring SpMSpV over a CSC tile.
 
     Args:
-      x_ind: int32[xcap] active column ids (padding >= ncols).
+      x_ind: int32[xcap] active column ids; padding slots hold ids >= ncols
+        (the sentinel convention — prefix position does not matter). Valid
+        ids must be DISTINCT: the expansion bound below assumes each matrix
+        entry is touched at most once.
       x_val: values aligned with x_ind.
-      x_nnz: dynamic count of valid x entries.
+      x_nnz: dynamic count of valid x entries (bookkeeping only; validity is
+        decided by the sentinel, matching the SpTuples convention).
       out_capacity: static bound on distinct output rows (<= nrows).
 
     Returns (y_ind, y_val, y_nnz): compacted sparse output, row-sorted.
@@ -77,15 +81,14 @@ def spmspv(
     two-phase bucket routing with expand (column walks flattened to static
     slots) → semiring combine by destination row → compaction.
     """
-    xcap = x_ind.shape[0]
-    slotmask = jnp.arange(xcap, dtype=jnp.int32) < x_nnz
-    x_ind = jnp.where(slotmask, x_ind, a_csc.ncols)
+    del x_nnz  # validity comes from the sentinel ids
+    x_ind = jnp.where(x_ind < a_csc.ncols, x_ind, a_csc.ncols)
     # Column lengths for each active x entry (0 for padding).
     lens_pad = jnp.concatenate([a_csc.col_lens(), jnp.zeros((1,), jnp.int32)])
     starts_pad = jnp.concatenate([a_csc.indptr[:-1], jnp.zeros((1,), jnp.int32)])
     xlens = lens_pad[jnp.minimum(x_ind, a_csc.ncols)]
-    # Expansion capacity: every valid A entry can be touched at most once per
-    # distinct active column, bounded by the tile capacity.
+    # Expansion capacity: with distinct active columns (precondition above),
+    # every valid A entry is touched at most once → tile capacity bounds it.
     exp_cap = a_csc.capacity
     owner, offset, valid, _total = expand_ranges(xlens, exp_cap)
     src_col_start = starts_pad[jnp.minimum(x_ind[owner], a_csc.ncols)]

@@ -1,0 +1,51 @@
+"""Semiring collectives over mesh axes — the MPIOp analog.
+
+The reference lazily wraps arbitrary C++ binary functors into ``MPI_Op``s with
+POD fast paths to ``MPI_SUM/MIN/MAX`` (``include/CombBLAS/MPIOp.h:66-110``).
+The TPU analog: a semiring ``add`` with a known monoid kind rides the native
+XLA cross-replica reductions (``psum``/``pmin``/``pmax`` → ICI all-reduce);
+a generic monoid falls back to ``all_gather`` + a local tree fold, which XLA
+still schedules on ICI — the "auto MPI_Op_create" path.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..semiring import Semiring
+
+
+def axis_reduce(sr: Semiring, x: jax.Array, axis_name) -> jax.Array:
+    """All-reduce ``x`` over a mesh axis with the semiring's add monoid."""
+    if sr.add_kind == "sum":
+        return lax.psum(x, axis_name)
+    if sr.add_kind == "min":
+        return lax.pmin(x, axis_name)
+    if sr.add_kind == "max":
+        return lax.pmax(x, axis_name)
+    gathered = lax.all_gather(x, axis_name)  # [axis_size, ...]
+    n = gathered.shape[0]
+    acc = gathered[0]
+    for k in range(1, n):  # axis size is static; unrolled tree would also work
+        acc = sr.add(acc, gathered[k])
+    return acc
+
+
+def axis_reduce_scatter(sr: Semiring, x: jax.Array, axis_name) -> jax.Array:
+    """Reduce-scatter over a mesh axis (tiled along leading dim).
+
+    ``x`` has shape [axis_size * L, ...] per device; returns this device's
+    reduced [L, ...] chunk. Fast path uses ``psum_scatter``; generic monoids
+    all-reduce then slice. This is the fiber reduction of 3D SpGEMM
+    (``3DSpGEMM/Reductions.h``, ``ParFriends.h:3119-3180``) and the row-world
+    fold of dense SpMV (``ParFriends.h:1925-2155``).
+    """
+    if sr.add_kind == "sum":
+        return lax.psum_scatter(x, axis_name, scatter_dimension=0, tiled=True)
+    full = axis_reduce(sr, x, axis_name)
+    size = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    chunk = x.shape[0] // size
+    return lax.dynamic_slice_in_dim(full, idx * chunk, chunk, axis=0)

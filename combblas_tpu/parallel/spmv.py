@@ -1,0 +1,85 @@
+"""Distributed semiring SpMV over the 2D grid (≈ ParFriends SpMV family).
+
+The reference's dense-vector SpMV (``include/CombBLAS/ParFriends.h:1925-2155``)
+runs four explicit communication phases per call:
+
+    TransposeVector (diag pair Sendrecv)  →  AllGatherVector (col world)
+    →  local kernel  →  row-world fold (Alltoallv + MergeContributions)
+
+On TPU the first two phases are *free*: a col-aligned ``DistVec`` is already
+replicated down each grid column by its sharding, so the gather never appears
+in the program — XLA materializes the replication once, when the vector is
+built or realigned.  Only the fold remains: a semiring all-reduce over the
+``"c"`` axis (ICI all-reduce via psum/pmin/pmax, see collectives.py).
+
+The sparse-vector SpMSpV path (``ParFriends.h:1370-1923``,
+``BFSFriends.h:328-395``) works on padded (ind, val) frontier blocks and uses
+the same schedule with the local kernel swapped to ``ops.spmv.spmspv``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..ops.compressed import CSC
+from ..ops.spmv import spmspv as local_spmspv
+from ..ops.spmv import spmv as local_spmv
+from ..semiring import Semiring
+from .collectives import axis_reduce
+from .grid import COL_AXIS, ROW_AXIS
+from .spmat import TILE_SPEC, SpParMat
+from .vec import DistVec
+
+
+def dist_spmv(sr: Semiring, A: SpParMat, x: DistVec) -> DistVec:
+    """y = A ⊗ x over the grid: ``y[i] = ⊕_j A[i,j] ⊗ x[j]``.
+
+    x may be in either alignment; result is row-aligned.
+    """
+    assert x.length == A.ncols, (x.length, A.ncols)
+    x = x.realign("col")
+
+    def body(rows, cols, vals, nnz, xblk):
+        t = A.local_tile(rows, cols, vals, nnz)
+        y_loc = local_spmv(sr, t, xblk[0])  # [lr]
+        return axis_reduce(sr, y_loc, COL_AXIS)[None]
+
+    blocks = jax.shard_map(
+        body,
+        mesh=A.grid.mesh,
+        in_specs=(TILE_SPEC,) * 4 + (P(COL_AXIS),),
+        out_specs=P(ROW_AXIS),
+    )(A.rows, A.cols, A.vals, A.nnz, x.blocks)
+    return DistVec(blocks=blocks, length=A.nrows, align="row", grid=A.grid)
+
+
+def dist_spmv_masked(
+    sr: Semiring, A: SpParMat, x: DistVec, row_active: DistVec
+) -> DistVec:
+    """SpMV suppressing rows where ``row_active`` (row-aligned bool) is False.
+
+    The distributed analog of the Graph500 fused kernel's BitMap dedup
+    (``BFSFriends.h:59-182``): already-visited vertices never re-enter y.
+    Masking happens *before* the fold, so suppressed rows cost no collective
+    bandwidth semantics-wise (XLA still moves the lane, but the value is the
+    identity).
+    """
+    assert x.length == A.ncols
+    x = x.realign("col")
+    row_active = row_active.realign("row")
+
+    def body(rows, cols, vals, nnz, xblk, actblk):
+        t = A.local_tile(rows, cols, vals, nnz)
+        y_loc = local_spmv(sr, t, xblk[0])
+        y_loc = jnp.where(actblk[0], y_loc, sr.zero(y_loc.dtype))
+        return axis_reduce(sr, y_loc, COL_AXIS)[None]
+
+    blocks = jax.shard_map(
+        body,
+        mesh=A.grid.mesh,
+        in_specs=(TILE_SPEC,) * 4 + (P(COL_AXIS), P(ROW_AXIS)),
+        out_specs=P(ROW_AXIS),
+    )(A.rows, A.cols, A.vals, A.nnz, x.blocks, row_active.blocks)
+    return DistVec(blocks=blocks, length=A.nrows, align="row", grid=A.grid)

@@ -1,0 +1,213 @@
+"""Distributed dense / sparse vectors (≈ FullyDistVec / FullyDistSpVec).
+
+The reference distributes vectors over ALL p processes in matrix-conformant
+two-level blocks (``include/CombBLAS/FullyDist.h:44-57``) so that the
+column-world allgather re-assembles exactly the x-block a local tile needs.
+On TPU the replication that MPI must construct by communication comes for
+free from sharding: a vector is stored as ``[pa, L]`` blocks sharded over ONE
+mesh axis and *replicated* over the other by XLA — so the reference's
+``TransposeVector + AllGatherVector`` pre-phase (``ParFriends.h:1388-1478``)
+vanishes from SpMV entirely; only alignment conversions pay communication.
+
+Alignment:
+  * ``"col"``-aligned: block j lives on grid column j (what SpMV consumes).
+  * ``"row"``-aligned: block i lives on grid row i (what SpMV produces).
+
+``realign`` converts between them — a ``ppermute`` complement-rank pair
+exchange on square grids (the reference's diagonal Sendrecv,
+``SpParMat.cpp:3554-3570``), falling back to allgather+slice on rectangular
+grids.
+
+Sparse vectors (``SpDistVec``) carry padded (ind, val) slot arrays + nnz,
+mirroring ``FullyDistSpVec``'s ind/num arrays (``FullyDistSpVec.h:75``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..semiring import Semiring
+from .collectives import axis_reduce
+from .grid import COL_AXIS, ROW_AXIS, Grid
+
+Array = jax.Array
+
+
+def _np_pad_blocks(x: np.ndarray, nblocks: int, fill) -> np.ndarray:
+    L = -(-x.shape[0] // nblocks)
+    out = np.full((nblocks, L), fill, dtype=x.dtype)
+    flat = out.reshape(-1)
+    flat[: x.shape[0]] = x
+    return flat.reshape(nblocks, L)
+
+
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=["blocks"],
+    meta_fields=["length", "align", "grid"],
+)
+@dataclasses.dataclass(frozen=True)
+class DistVec:
+    """Dense distributed vector: ``blocks[pa, L]`` sharded over one mesh axis.
+
+    Padding slots (beyond ``length``) must hold values that are inert for the
+    ops applied to them (constructors fill the reduction identity).
+    """
+
+    blocks: Array  # [pa, L]
+    length: int
+    align: str  # "row" | "col"
+    grid: Grid
+
+    @property
+    def nblocks(self) -> int:
+        return self.blocks.shape[0]
+
+    @property
+    def block_len(self) -> int:
+        return self.blocks.shape[1]
+
+    def axis_name(self) -> str:
+        # Blocks of a row-aligned vector vary over grid rows (mesh axis "r").
+        return ROW_AXIS if self.align == "row" else COL_AXIS
+
+    def sharding(self) -> NamedSharding:
+        return NamedSharding(self.grid.mesh, P(self.axis_name()))
+
+    # --- construction -----------------------------------------------------
+
+    @staticmethod
+    def from_global(grid: Grid, x, align: str = "col", fill=0) -> "DistVec":
+        x = np.asarray(x)
+        pa = grid.pr if align == "row" else grid.pc
+        blocks = _np_pad_blocks(x, pa, np.asarray(fill, dtype=x.dtype))
+        sharding = NamedSharding(
+            grid.mesh, P(ROW_AXIS if align == "row" else COL_AXIS)
+        )
+        return DistVec(
+            blocks=jax.device_put(jnp.asarray(blocks), sharding),
+            length=int(x.shape[0]),
+            align=align,
+            grid=grid,
+        )
+
+    @staticmethod
+    def full(grid: Grid, length: int, value, dtype, align: str = "col") -> "DistVec":
+        pa = grid.pr if align == "row" else grid.pc
+        L = -(-length // pa)
+        sharding = NamedSharding(
+            grid.mesh, P(ROW_AXIS if align == "row" else COL_AXIS)
+        )
+        blocks = jax.device_put(
+            jnp.full((pa, L), value, dtype=dtype), sharding
+        )
+        return DistVec(blocks=blocks, length=length, align=align, grid=grid)
+
+    @staticmethod
+    def iota(grid: Grid, length: int, dtype=jnp.int32, align: str = "col") -> "DistVec":
+        """Reference: ``FullyDistVec::iota``."""
+        pa = grid.pr if align == "row" else grid.pc
+        L = -(-length // pa)
+        vals = jnp.arange(pa * L, dtype=dtype).reshape(pa, L)
+        sharding = NamedSharding(
+            grid.mesh, P(ROW_AXIS if align == "row" else COL_AXIS)
+        )
+        return DistVec(
+            blocks=jax.device_put(vals, sharding),
+            length=length, align=align, grid=grid,
+        )
+
+    # --- host access (tests / small data) ---------------------------------
+
+    def to_global(self) -> np.ndarray:
+        return np.asarray(self.blocks).reshape(-1)[: self.length]
+
+    # --- elementwise ------------------------------------------------------
+
+    def apply(self, fn) -> "DistVec":
+        """Reference: ``FullyDistVec::Apply``."""
+        return dataclasses.replace(self, blocks=fn(self.blocks))
+
+    def ewise(self, other: "DistVec", fn) -> "DistVec":
+        """Blockwise binary op; alignments must match.
+
+        Reference: ``FullyDistVec::EWiseApply`` (FullyDistVec.h).
+        """
+        assert self.align == other.align and self.length == other.length
+        return dataclasses.replace(self, blocks=fn(self.blocks, other.blocks))
+
+    def mask_padding(self, fill) -> "DistVec":
+        """Force padding slots (global index >= length) to ``fill``."""
+        pa, L = self.blocks.shape
+        gids = jnp.arange(pa * L).reshape(pa, L)
+        return dataclasses.replace(
+            self,
+            blocks=jnp.where(gids < self.length, self.blocks, fill),
+        )
+
+    def reduce(self, sr: Semiring) -> Array:
+        """Global fold with sr.add → replicated scalar.
+
+        Padding must hold the identity (use mask_padding first if unsure).
+        Reference: ``FullyDistVec::Reduce``.
+        """
+        if sr.add_kind == "sum":
+            return jnp.sum(self.blocks)
+        if sr.add_kind == "min":
+            return jnp.min(self.blocks)
+        if sr.add_kind == "max":
+            return jnp.max(self.blocks)
+        return jax.lax.reduce(
+            self.blocks, sr.zero(self.blocks.dtype), sr.add, (0, 1)
+        )
+
+    # --- alignment conversion (the TransposeVector analog) ----------------
+
+    def realign(self, align: str) -> "DistVec":
+        if align == self.align:
+            return self
+        grid = self.grid
+        src_axis = self.axis_name()
+        dst_pa = grid.pr if align == "row" else grid.pc
+        dst_sharding = NamedSharding(
+            grid.mesh, P(ROW_AXIS if align == "row" else COL_AXIS)
+        )
+
+        if grid.is_square:
+            # Complement-rank pair exchange: device (i,j) holds block i
+            # (row-aligned); after ppermute from (j,i), it holds block j.
+            perm = grid.transpose_perm()
+
+            def shift(b):  # b: [1, L]
+                return lax.ppermute(b, (ROW_AXIS, COL_AXIS), perm)
+
+            blocks = jax.shard_map(
+                shift,
+                mesh=grid.mesh,
+                in_specs=P(src_axis),
+                out_specs=P(ROW_AXIS if align == "row" else COL_AXIS),
+                # The permutation provably delivers block j to every (i, j),
+                # i.e. the output IS replicated along the unlisted axis, but
+                # shard_map cannot infer that through ppermute.
+                check_vma=False,
+            )(self.blocks)
+        else:
+            # Rectangular grid: allgather the full vector along the source
+            # axis, then let resharding slice out the destination blocks.
+            full = self.blocks.reshape(-1)
+            pa = dst_pa
+            L = -(-full.shape[0] // pa)
+            pad = pa * L - full.shape[0]
+            if pad:
+                full = jnp.concatenate([full, jnp.zeros((pad,), full.dtype)])
+            blocks = jax.device_put(full.reshape(pa, L), dst_sharding)
+        return DistVec(
+            blocks=blocks, length=self.length, align=align, grid=grid
+        )

@@ -100,12 +100,26 @@ PLUS_TIMES = Semiring(
     add_kind="sum",
 )
 
+def _saturating_plus(a, x):
+    """a + x that absorbs the MIN_PLUS identity (＋∞ / INT_MAX) exactly.
+
+    Plain integer addition would wrap INT_MAX + w around to a huge negative
+    "distance"; the reference's MinPlusSRing avoids this with an explicit
+    infinity check in ``add``/``multiply`` — we do the same branch-free.
+    """
+    rd = jnp.result_type(a, x)
+    top = _maxval(rd)
+    a_ = jnp.asarray(a).astype(rd)
+    x_ = jnp.asarray(x).astype(rd)
+    return jnp.where((a_ >= top) | (x_ >= top), top, a_ + x_)
+
+
 #: Tropical (min, +): SSSP / Bellman-Ford.
 #: Reference: ``MinPlusSRing`` (Semirings.h:236).
 MIN_PLUS = Semiring(
     name="min_plus",
     add=jnp.minimum,
-    mul=lambda a, x: a + x,
+    mul=_saturating_plus,
     zero_fn=_maxval,
     one_fn=lambda dt: 0,
     add_kind="min",
@@ -118,7 +132,9 @@ SELECT2ND_MAX = Semiring(
     name="select2nd_max",
     add=jnp.maximum,
     mul=lambda a, x: x,
-    zero_fn=lambda dt: -1 if jnp.issubdtype(jnp.dtype(dt), jnp.integer) else _minval(dt),
+    zero_fn=lambda dt: (
+        -1 if jnp.issubdtype(jnp.dtype(dt), jnp.signedinteger) else _minval(dt)
+    ),
     one_fn=None,
     add_kind="max",
 )

@@ -1,0 +1,113 @@
+"""Distributed SpMV and end-to-end BFS on virtual meshes.
+
+The reference's BFS drivers self-check via traversal stats on generated
+R-MATs (SURVEY.md §4.3); we go further and validate the whole parent tree
+against a host BFS (the Graph500 verify.c checks the reference never wires
+in).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from combblas_tpu import MIN_PLUS, PLUS_TIMES, SELECT2ND_MAX
+from combblas_tpu.models.bfs import bfs, traversed_edges, validate_bfs_tree
+from combblas_tpu.parallel.grid import Grid
+from combblas_tpu.parallel.spmat import SpParMat
+from combblas_tpu.parallel.spmv import dist_spmv
+from combblas_tpu.parallel.vec import DistVec
+from combblas_tpu.utils.rmat import rmat_edges, rmat_symmetric_coo
+from conftest import random_dense
+
+GRIDS = [(1, 1), (2, 2), (2, 4)]
+
+
+@pytest.fixture(params=GRIDS, ids=[f"{a}x{b}" for a, b in GRIDS])
+def grid(request):
+    return Grid.make(*request.param)
+
+
+def test_dist_spmv_plus_times(grid, rng):
+    d = random_dense(rng, 22, 17)
+    A = SpParMat.from_dense(grid, d)
+    x = rng.random(17).astype(np.float32)
+    y = dist_spmv(PLUS_TIMES, A, DistVec.from_global(grid, x))
+    assert y.align == "row"
+    np.testing.assert_allclose(y.to_global(), d @ x, rtol=1e-5)
+
+
+def test_dist_spmv_min_plus(grid, rng):
+    d = random_dense(rng, 11, 11, 0.4)
+    A = SpParMat.from_dense(grid, d)
+    x = rng.random(11).astype(np.float32)
+    y = dist_spmv(MIN_PLUS, A, DistVec.from_global(grid, x))
+    expect = np.where(d != 0, d + x[None, :], np.inf).min(axis=1)
+    got = y.to_global()
+    mask = ~np.isinf(expect)
+    np.testing.assert_allclose(got[mask], expect[mask], rtol=1e-6)
+    assert np.all(np.isinf(got[~mask]))
+
+
+def test_dist_spmv_jitted(grid, rng):
+    d = random_dense(rng, 16, 16)
+    A = SpParMat.from_dense(grid, d)
+    x = DistVec.from_global(grid, rng.random(16).astype(np.float32))
+    f = jax.jit(lambda A, x: dist_spmv(PLUS_TIMES, A, x))
+    np.testing.assert_allclose(f(A, x).to_global(), d @ x.to_global(), rtol=1e-5)
+
+
+def test_rmat_generator_deterministic():
+    key = jax.random.key(7)
+    s1, d1 = rmat_edges(key, 8, 1000)
+    s2, d2 = rmat_edges(key, 8, 1000)
+    np.testing.assert_array_equal(np.asarray(s1), np.asarray(s2))
+    assert np.asarray(s1).max() < 256 and np.asarray(d1).min() >= 0
+    # skewed degree distribution: top vertex should have far more than mean
+    deg = np.bincount(np.asarray(s1), minlength=256)
+    assert deg.max() > 4 * deg.mean()
+
+
+def test_bfs_small_path_graph(grid):
+    # path 0-1-2-3-4 plus isolated 5,6
+    n = 7
+    d = np.zeros((n, n), np.float32)
+    for u, v in [(0, 1), (1, 2), (2, 3), (3, 4)]:
+        d[u, v] = d[v, u] = 1
+    A = SpParMat.from_dense(grid, d)
+    parents, levels, niter = bfs(A, 0)
+    np.testing.assert_array_equal(levels.to_global(), [0, 1, 2, 3, 4, -1, -1])
+    assert validate_bfs_tree(d, 0, parents.to_global(), levels.to_global()) == []
+    assert int(niter) == 5  # 4 expanding levels + 1 empty-frontier detection
+
+
+def test_bfs_rmat(grid):
+    rows, cols = rmat_symmetric_coo(jax.random.key(3), scale=7, edgefactor=8)
+    n = 1 << 7
+    A = SpParMat.from_global_coo(
+        grid, rows, cols, np.ones(len(rows), np.float32), n, n,
+        dedup_sr=PLUS_TIMES,
+    )
+    d = A.to_dense()
+    src = int(np.argmax((d != 0).sum(axis=0)))  # highest-degree vertex
+    parents, levels, _ = bfs(A, src)
+    errs = validate_bfs_tree(d, src, parents.to_global(), levels.to_global())
+    assert errs == [], errs[:5]
+    te = int(traversed_edges(A, parents))
+    assert te > 0
+
+
+def test_bfs_matches_across_grids():
+    rows, cols = rmat_symmetric_coo(jax.random.key(5), scale=6, edgefactor=8)
+    n = 64
+    levels_by_grid = []
+    for g in GRIDS:
+        grid = Grid.make(*g)
+        A = SpParMat.from_global_coo(
+            grid, rows, cols, np.ones(len(rows), np.float32), n, n,
+            dedup_sr=PLUS_TIMES,
+        )
+        _, levels, _ = bfs(A, 0)
+        levels_by_grid.append(levels.to_global())
+    for lv in levels_by_grid[1:]:
+        np.testing.assert_array_equal(lv, levels_by_grid[0])

@@ -1,0 +1,119 @@
+"""Distributed matrix/vector layer on the 8-device virtual CPU mesh.
+
+Grid-shape coverage mirrors the reference's mpirun -np {1,4,16} pattern
+(SURVEY.md §4.4): 1x1, 2x2 (square) and 2x4/4x2 (rectangular) grids.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from combblas_tpu import MIN_PLUS, PLUS_TIMES, SELECT2ND_MAX
+from combblas_tpu.parallel.grid import Grid
+from combblas_tpu.parallel.spmat import SpParMat
+from combblas_tpu.parallel.vec import DistVec
+from conftest import random_dense
+
+GRIDS = [(1, 1), (2, 2), (2, 4), (4, 2)]
+
+
+@pytest.fixture(params=GRIDS, ids=[f"{a}x{b}" for a, b in GRIDS])
+def grid(request):
+    return Grid.make(*request.param)
+
+
+def test_roundtrip(grid, rng):
+    d = random_dense(rng, 19, 23)
+    A = SpParMat.from_dense(grid, d)
+    np.testing.assert_array_equal(A.to_dense(), d)
+    assert int(A.getnnz()) == np.count_nonzero(d)
+
+
+def test_apply_prune(grid, rng):
+    d = random_dense(rng, 16, 16)
+    A = SpParMat.from_dense(grid, d)
+    np.testing.assert_allclose(A.apply(lambda v: v * 3).to_dense(), d * 3, rtol=1e-6)
+    p = A.prune(lambda v: v > 0.5)
+    np.testing.assert_array_equal(p.to_dense(), np.where(d > 0.5, 0, d))
+
+
+def test_reduce_rows_cols(grid, rng):
+    d = random_dense(rng, 12, 18)
+    A = SpParMat.from_dense(grid, d)
+    colsum = A.reduce(PLUS_TIMES, axis="rows")
+    assert colsum.align == "col"
+    np.testing.assert_allclose(colsum.to_global(), d.sum(axis=0), rtol=1e-5)
+    rowsum = A.reduce(PLUS_TIMES, axis="cols")
+    assert rowsum.align == "row"
+    np.testing.assert_allclose(rowsum.to_global(), d.sum(axis=1), rtol=1e-5)
+    # min-reduce with mapped values (degrees): count entries per row
+    deg = A.reduce(PLUS_TIMES, axis="cols", map_fn=lambda v: jnp.ones_like(v))
+    np.testing.assert_array_equal(deg.to_global(), (d != 0).sum(axis=1))
+
+
+def test_ewise_mult(grid, rng):
+    d1 = random_dense(rng, 14, 14, 0.4)
+    d2 = random_dense(rng, 14, 14, 0.4)
+    A = SpParMat.from_dense(grid, d1)
+    B = SpParMat.from_dense(grid, d2)
+    keep = A.ewise_mult(B)
+    np.testing.assert_array_equal(keep.to_dense(), np.where(d2 != 0, d1, 0))
+    excl = A.ewise_mult(B, negate=True)
+    np.testing.assert_array_equal(excl.to_dense(), np.where(d2 != 0, 0, d1))
+    prod = A.ewise_mult(B, combine=lambda x, y: x * y)
+    np.testing.assert_allclose(prod.to_dense(), d1 * d2, rtol=1e-6)
+
+
+def test_dim_apply(grid, rng):
+    d = random_dense(rng, 10, 12)
+    A = SpParMat.from_dense(grid, d)
+    colscale = rng.random(12).astype(np.float32)
+    v = DistVec.from_global(grid, colscale, align="col")
+    scaled = A.dim_apply(v, lambda a, s: a * s, axis="cols")
+    np.testing.assert_allclose(scaled.to_dense(), d * colscale[None, :], rtol=1e-6)
+    rowscale = rng.random(10).astype(np.float32)
+    vr = DistVec.from_global(grid, rowscale, align="row")
+    scaled_r = A.dim_apply(vr, lambda a, s: a * s, axis="rows")
+    np.testing.assert_allclose(scaled_r.to_dense(), d * rowscale[:, None], rtol=1e-6)
+
+
+def test_transpose_square_grids(rng):
+    for shape in [(1, 1), (2, 2)]:
+        grid = Grid.make(*shape)
+        d = random_dense(rng, 15, 9)
+        A = SpParMat.from_dense(grid, d)
+        np.testing.assert_array_equal(A.transpose().to_dense(), d.T)
+
+
+def test_vec_realign(grid, rng):
+    x = rng.random(21).astype(np.float32)
+    v = DistVec.from_global(grid, x, align="col")
+    r = v.realign("row")
+    assert r.align == "row"
+    np.testing.assert_array_equal(r.to_global(), x)
+    back = r.realign("col")
+    np.testing.assert_array_equal(back.to_global(), x)
+
+
+def test_vec_ops(grid, rng):
+    x = rng.random(17).astype(np.float32)
+    y = rng.random(17).astype(np.float32)
+    vx = DistVec.from_global(grid, x)
+    vy = DistVec.from_global(grid, y)
+    np.testing.assert_allclose(
+        vx.ewise(vy, jnp.add).to_global(), x + y, rtol=1e-6
+    )
+    np.testing.assert_allclose(float(vx.reduce(PLUS_TIMES)), x.sum(), rtol=1e-5)
+    np.testing.assert_allclose(
+        float(vx.mask_padding(-np.inf).reduce(SELECT2ND_MAX)), x.max(), rtol=1e-6
+    )
+    it = DistVec.iota(grid, 17)
+    np.testing.assert_array_equal(it.to_global(), np.arange(17))
+
+
+def test_load_imbalance(grid, rng):
+    d = random_dense(rng, 16, 16, 0.5)
+    A = SpParMat.from_dense(grid, d)
+    li = float(A.load_imbalance())
+    assert li >= 1.0

@@ -1,0 +1,48 @@
+"""Regression tests for semiring edge cases found in review."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from combblas_tpu import MIN_PLUS, SELECT2ND_MAX, SpTuples
+from combblas_tpu.ops.compressed import CSC
+from combblas_tpu.ops.spmv import spmspv, spmv
+
+
+def test_min_plus_integer_no_wraparound():
+    # Unreached vertex (INT_MAX) must stay unreached, not wrap negative.
+    d = np.array([[2, 3], [0, 1]], np.int32)
+    t = SpTuples.from_dense(d)
+    imax = np.iinfo(np.int32).max
+    x = np.array([imax, 5], np.int32)
+    y = np.asarray(spmv(MIN_PLUS, t, x))
+    assert y[0] == 8  # min(2+inf, 3+5)
+    assert y[1] == 6  # 1+5 (d[1,0]==0 is not stored)
+
+
+def test_min_plus_both_identities():
+    assert int(MIN_PLUS.mul(jnp.int32(np.iinfo(np.int32).max), jnp.int32(7))) == np.iinfo(np.int32).max
+    assert int(MIN_PLUS.mul(jnp.int32(3), jnp.int32(4))) == 7
+
+
+def test_select2nd_max_unsigned_zero():
+    z = SELECT2ND_MAX.zero(jnp.uint32)
+    assert int(z) == 0  # minval of uint32, no OverflowError
+
+
+def test_spmspv_sentinel_not_prefix():
+    # Valid entry NOT in the prefix — sentinel convention must govern.
+    d = np.zeros((3, 2), np.float32)
+    d[0, 1] = 2.0
+    t = SpTuples.from_dense(d)
+    csc = CSC.from_tuples(t)
+    x_ind = np.array([2, 1], np.int32)  # slot 0 is padding (>= ncols)
+    x_val = np.array([0.0, 5.0], np.float32)
+    from combblas_tpu import PLUS_TIMES
+
+    y_ind, y_val, y_nnz = spmspv(
+        PLUS_TIMES, csc, jnp.asarray(x_ind), jnp.asarray(x_val),
+        jnp.int32(1), out_capacity=3,
+    )
+    assert int(y_nnz) == 1
+    assert int(np.asarray(y_ind)[0]) == 0
+    assert float(np.asarray(y_val)[0]) == 10.0
