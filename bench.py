@@ -1,0 +1,82 @@
+"""Graph500 BFS benchmark on the real TPU chip.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "MTEPS", "vs_baseline": N}
+
+Protocol (mirrors the reference's TopDownBFS driver, TopDownBFS.cpp:421-479):
+R-MAT scale-S graph (edgefactor 16, symmetrized, deloop'd), BFS from NROOTS
+random reachable roots, harmonic-mean MTEPS over roots, where traversed
+edges = edges incident to discovered vertices / 2 (kernel-2 accounting).
+
+vs_baseline compares single-chip MTEPS against the smallest archived
+reference run: 1,636 MTEPS on 1,024 Hopper (Cray XE6) cores
+(BASELINE.md: HopperResults/script1024.reducedgraph_mini:149). One v5e chip
+vs 1,024 CPU cores — values < 1 are expected until multi-chip rounds.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+SCALE = int(os.environ.get("BENCH_SCALE", "19"))
+EDGEFACTOR = int(os.environ.get("BENCH_EDGEFACTOR", "16"))
+NROOTS = int(os.environ.get("BENCH_NROOTS", "8"))
+BASELINE_MTEPS = 1636.0  # Hopper 1024 cores, R-MAT "mini"
+
+
+def main():
+    import jax
+    import numpy as np
+
+    from combblas_tpu import PLUS_TIMES
+    from combblas_tpu.models.bfs import bfs, traversed_edges
+    from combblas_tpu.parallel.grid import Grid
+    from combblas_tpu.parallel.spmat import SpParMat
+    from combblas_tpu.utils.rmat import rmat_symmetric_coo
+
+    grid = Grid.make(1, 1)
+    n = 1 << SCALE
+    rows, cols = rmat_symmetric_coo(jax.random.key(42), scale=SCALE, edgefactor=EDGEFACTOR)
+    A = SpParMat.from_global_coo(
+        grid, rows, cols, np.ones(len(rows), np.float32), n, n,
+        dedup_sr=PLUS_TIMES,
+    )
+    # roots: vertices with nonzero degree, deterministic choice
+    deg = np.zeros(n, np.int64)
+    np.add.at(deg, rows, 1)
+    candidates = np.flatnonzero(deg > 0)
+    rng = np.random.default_rng(7)
+    roots = rng.choice(candidates, size=NROOTS, replace=False)
+
+    # warmup/compile on first root
+    p, l, it = bfs(A, int(roots[0]))
+    jax.block_until_ready(p.blocks)
+
+    teps = []
+    for r in roots:
+        t0 = time.perf_counter()
+        parents, levels, niter = bfs(A, int(r))
+        jax.block_until_ready(parents.blocks)
+        dt = time.perf_counter() - t0
+        te = int(traversed_edges(A, parents))
+        if te > 0:
+            teps.append(te / dt)
+    hmean = len(teps) / sum(1.0 / t for t in teps)
+    mteps = hmean / 1e6
+    print(
+        json.dumps(
+            {
+                "metric": f"graph500_bfs_rmat_scale{SCALE}_1chip_harmonic_MTEPS",
+                "value": round(mteps, 2),
+                "unit": "MTEPS",
+                "vs_baseline": round(mteps / BASELINE_MTEPS, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

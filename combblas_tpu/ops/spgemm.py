@@ -1,0 +1,94 @@
+"""Local semiring SpGEMM — expansion / sort / compression (ESC).
+
+The reference's local SpGEMM (``include/CombBLAS/mtSpGEMM.h:214-440``) runs a
+two-pass symbolic+numeric hash/heap kernel with a per-column heap-vs-hash
+choice (compression ratio < 2.0 → heap, :310-311) and OpenMP over columns.
+Per-column dynamic hashing is hostile to TPU vectorization, so the TPU-native
+kernel is the classic ESC formulation — every phase is a primitive XLA is
+good at:
+
+  1. EXPAND: one slot per scalar multiply (flop). For A entry (i,k,a) and
+     B's row k, emit (i, j, a⊗b) for each (k,j,b) — flattened to a static
+     ``flop_capacity`` via ``expand_ranges`` (no per-column loops).
+  2. SORT: lexicographic (row, col) ``lax.sort`` — TPU's native sort.
+  3. COMPRESS: segmented semiring fold + compaction (``SpTuples.compact``).
+
+The symbolic pass of the reference (``estimateFLOP`` :1058,
+``estimateNNZ_Hash`` :807) maps to ``flops`` below: exact flop counting is a
+one-gather + segment-sum, and callers size ``flop_capacity`` from it outside
+jit (capacities are trace-time constants — the XLA analog of the
+reference's exact preallocation).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..semiring import Semiring
+from .compressed import CSR
+from .segment import expand_ranges
+from .tuples import SpTuples
+
+Array = jax.Array
+
+
+def flops(a: SpTuples, b_csr: CSR) -> Array:
+    """Scalar-multiply count of a·b (≈ estimateFLOP, mtSpGEMM.h:1058).
+
+    Accumulated in float32: true counts can exceed int32 at scale (the
+    reference uses int64, which JAX disables by default), and a capacity
+    estimate only needs ~7 significant digits — callers add multiplicative
+    slack (see ``summa_capacities``).
+    """
+    assert a.ncols == b_csr.nrows
+    lens_pad = jnp.concatenate([b_csr.row_lens(), jnp.zeros((1,), jnp.int32)])
+    k = jnp.minimum(a.cols, b_csr.nrows)
+    per_entry = jnp.where(a.valid_mask(), lens_pad[k], 0)
+    return jnp.sum(per_entry.astype(jnp.float32))
+
+
+def expand(sr: Semiring, a: SpTuples, b_csr: CSR, flop_capacity: int) -> SpTuples:
+    """EXPAND phase: uncombined product tuples (duplicates included).
+
+    Output tile has shape (a.nrows, b.ncols) and capacity ``flop_capacity``;
+    flops beyond the capacity are silently truncated — callers must size via
+    ``flops`` (for exactness) or a proven bound.
+    """
+    assert a.ncols == b_csr.nrows
+    lens_pad = jnp.concatenate([b_csr.row_lens(), jnp.zeros((1,), jnp.int32)])
+    starts_pad = jnp.concatenate([b_csr.indptr[:-1], jnp.zeros((1,), jnp.int32)])
+    k = jnp.minimum(a.cols, b_csr.nrows)
+    deg = jnp.where(a.valid_mask(), lens_pad[k], 0)
+    owner, offset, valid, _ = expand_ranges(deg, flop_capacity)
+    k_o = jnp.minimum(a.cols[owner], b_csr.nrows)
+    b_slot = jnp.minimum(starts_pad[k_o] + offset, b_csr.capacity - 1)
+    rows = jnp.where(valid, a.rows[owner], a.nrows)
+    cols = jnp.where(valid, b_csr.indices[b_slot], b_csr.ncols)
+    vals = sr.mul(a.vals[owner], b_csr.vals[b_slot])
+    return SpTuples(
+        rows=rows,
+        cols=cols,
+        vals=vals,
+        nnz=jnp.sum(valid).astype(jnp.int32),
+        nrows=a.nrows,
+        ncols=b_csr.ncols,
+    )
+
+
+def local_spgemm(
+    sr: Semiring,
+    a: SpTuples,
+    b_csr: CSR,
+    *,
+    flop_capacity: int,
+    out_capacity: int,
+) -> SpTuples:
+    """C = A ⊗ B on one tile: expand → sort → compress.
+
+    ≈ ``LocalHybridSpGEMM`` (mtSpGEMM.h:214) with the hash/heap accumulator
+    replaced by sort+segmented-fold.
+    """
+    return expand(sr, a, b_csr, flop_capacity).compact(
+        sr, capacity=out_capacity
+    )

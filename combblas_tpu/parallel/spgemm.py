@@ -1,0 +1,230 @@
+"""Distributed SpGEMM: SUMMA over the device mesh (≈ ParFriends Mult_AnXBn_*).
+
+The reference's baseline ``Mult_AnXBn_Synch`` (``ParFriends.h:1005-1108``)
+runs √p stages; each stage broadcasts one A-block along the process row and
+one B-block along the process column (``SpParHelper::BCastMatrix``), does a
+local hash SpGEMM, and finally k-way-merges the √p stage outputs
+(``MultiwayMerge.h:412``).
+
+TPU-native schedule: the per-stage broadcasts collapse into ONE ``all_gather``
+of the A-tiles over the ``"c"`` axis and of the B-tiles over the ``"r"`` axis
+(same total bytes as the √p broadcasts, but a single fused ICI collective
+that XLA can software-pipeline), then a static python loop over stages feeds
+the local ESC kernel, and the merge is a single concat + sort + segmented
+fold — the MultiwayMerge heap becomes the TPU's native sort.  The
+double-buffered / overlapped variants (``ParFriends.h:799,1111``) are
+subsumed: XLA overlaps the gather with the first stages automatically.
+
+A ring variant (lower peak memory, ≈ SUMMA with in-place rotation à la
+``BFSFriends``' carousel) swaps the all_gather for per-stage ``ppermute``;
+see ``ring=True``.
+
+Capacity model (the static-shape analog of ``EstimateFLOP`` /
+``EstPerProcessNnzSUMMA``, ``ParFriends.h:356-448,1243-1349``): callers pass
+``flop_capacity`` (per stage, per tile) and ``out_capacity`` (final tile
+nnz), or use ``summa_capacities`` to measure them exactly with a cheap
+distributed symbolic pass before jitting the numeric one.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..ops.compressed import CSR
+from ..ops.spgemm import expand as esc_expand
+from ..ops.tuples import SpTuples
+from ..semiring import Semiring
+from .grid import COL_AXIS, ROW_AXIS
+from .spmat import TILE_SPEC, SpParMat
+
+
+def _check_compat(A: SpParMat, B: SpParMat):
+    """≈ CheckSpGEMMCompliance + ProductGrid (ParFriends.h:161,
+    CommGrid.cpp:164)."""
+    assert A.grid == B.grid, "A and B must share a grid"
+    assert A.grid.is_square, "SUMMA requires a square grid (pr == pc)"
+    assert A.ncols == B.nrows, f"dim mismatch {A.ncols} != {B.nrows}"
+    assert A.grid.local_cols(A.ncols) == A.grid.local_rows(B.nrows), (
+        "A col-blocking must equal B row-blocking"
+    )
+
+
+def _gather_stage_tiles(t: SpTuples, axis_name, p: int) -> list[SpTuples]:
+    """All-gather a tile's arrays over a mesh axis → one SpTuples per stage.
+
+    The fused-collective replacement for the reference's per-stage
+    ``SpParHelper::BCastMatrix`` loop.
+    """
+    g = [lax.all_gather(x, axis_name) for x in (t.rows, t.cols, t.vals, t.nnz)]
+    return [
+        SpTuples(
+            rows=g[0][s], cols=g[1][s], vals=g[2][s], nnz=g[3][s],
+            nrows=t.nrows, ncols=t.ncols,
+        )
+        for s in range(p)
+    ]
+
+
+def summa_spgemm(
+    sr: Semiring,
+    A: SpParMat,
+    B: SpParMat,
+    *,
+    flop_capacity: int,
+    out_capacity: int,
+    ring: bool = False,
+) -> SpParMat:
+    """C = A ⊗ B over the grid.
+
+    ``flop_capacity`` bounds ONE stage's expansion on one tile;
+    ``out_capacity`` bounds the final per-tile nnz.
+    """
+    _check_compat(A, B)
+    grid = A.grid
+    p = grid.pr
+
+    def body(ar, ac, av, an, br, bc, bv, bn):
+        # stitch local tiles
+        a_mine = A.local_tile(ar, ac, av, an)
+        b_mine = B.local_tile(br, bc, bv, bn)
+
+        def stage_output(a_stage: SpTuples, b_stage: SpTuples) -> SpTuples:
+            b_csr = CSR.from_tuples(b_stage)
+            return esc_expand(sr, a_stage, b_csr, flop_capacity)
+
+        chunks = []
+        if not ring:
+            # A-tiles of my grid row / B-tiles of my grid column.
+            a_stages = _gather_stage_tiles(a_mine, COL_AXIS, p)
+            b_stages = _gather_stage_tiles(b_mine, ROW_AXIS, p)
+            for s in range(p):
+                chunks.append(stage_output(a_stages[s], b_stages[s]))
+        else:
+            # Cannon's algorithm: O(capacity) peak memory instead of
+            # O(p·capacity). Pre-skew with one joint-axis ppermute so device
+            # (i,j) starts with A_{i,(i+j)%p} and B_{(i+j)%p,j} — at stage s
+            # both held tiles share the contraction index k=(i+j+s)%p — then
+            # rotate A left / B up one step per stage (neighbor-only ICI
+            # traffic, the ring schedule of the reference's carousel,
+            # BitMapCarousel.h).
+            def joint_permute(t: SpTuples, perm) -> SpTuples:
+                return SpTuples(
+                    rows=lax.ppermute(t.rows, (ROW_AXIS, COL_AXIS), perm),
+                    cols=lax.ppermute(t.cols, (ROW_AXIS, COL_AXIS), perm),
+                    vals=lax.ppermute(t.vals, (ROW_AXIS, COL_AXIS), perm),
+                    nnz=lax.ppermute(t.nnz, (ROW_AXIS, COL_AXIS), perm),
+                    nrows=t.nrows, ncols=t.ncols,
+                )
+
+            skew_a = [
+                (i * p + (i + j) % p, i * p + j)
+                for i in range(p) for j in range(p)
+            ]
+            skew_b = [
+                (((i + j) % p) * p + j, i * p + j)
+                for i in range(p) for j in range(p)
+            ]
+            rot_a = [
+                (i * p + (j + 1) % p, i * p + j)
+                for i in range(p) for j in range(p)
+            ]
+            rot_b = [
+                (((i + 1) % p) * p + j, i * p + j)
+                for i in range(p) for j in range(p)
+            ]
+            a_cur = joint_permute(a_mine, skew_a)
+            b_cur = joint_permute(b_mine, skew_b)
+            for s in range(p):
+                chunks.append(stage_output(a_cur, b_cur))
+                if s != p - 1:
+                    a_cur = joint_permute(a_cur, rot_a)
+                    b_cur = joint_permute(b_cur, rot_b)
+
+        merged = SpTuples.concat(chunks)
+        out = merged.compact(sr, capacity=out_capacity)
+        return SpParMat._pack_tile(out)
+
+    r, c, v, n = jax.shard_map(
+        body,
+        mesh=grid.mesh,
+        in_specs=(TILE_SPEC,) * 8,
+        out_specs=(TILE_SPEC,) * 4,
+        check_vma=False,
+    )(A.rows, A.cols, A.vals, A.nnz, B.rows, B.cols, B.vals, B.nnz)
+    return SpParMat(
+        rows=r, cols=c, vals=v, nnz=n,
+        nrows=A.nrows, ncols=B.ncols, grid=grid,
+    )
+
+
+def summa_stage_flops(A: SpParMat, B: SpParMat) -> jax.Array:
+    """[p, pr, pc] float32 flop count per stage per output tile.
+
+    The distributed symbolic pass (≈ EstimateFLOP, ParFriends.h:356-448).
+    Values only (no ``vals`` arrays) cross the ICI: flop counting needs A's
+    (rows, cols) for validity/contraction ids and B's rows for row lengths.
+    """
+    _check_compat(A, B)
+    grid = A.grid
+    p = grid.pr
+    lrB = B.local_rows
+
+    def body(ar, ac, br):
+        a_rows, a_cols = ar[0, 0], ac[0, 0]
+        b_rows = br[0, 0]
+        ag_rows = lax.all_gather(a_rows, COL_AXIS)
+        ag_cols = lax.all_gather(a_cols, COL_AXIS)
+        bg_rows = lax.all_gather(b_rows, ROW_AXIS)
+        per_stage = []
+        for s in range(p):
+            b_valid = bg_rows[s] < lrB
+            blens = jax.ops.segment_sum(
+                b_valid.astype(jnp.int32), bg_rows[s], num_segments=lrB + 1
+            )
+            a_valid = ag_rows[s] < A.local_rows
+            k = jnp.minimum(ag_cols[s], lrB)
+            per_entry = jnp.where(a_valid, blens[k], 0)
+            per_stage.append(jnp.sum(per_entry.astype(jnp.float32)))
+        return jnp.stack(per_stage)[:, None, None]
+
+    return jax.shard_map(
+        body,
+        mesh=grid.mesh,
+        in_specs=(TILE_SPEC,) * 3,
+        out_specs=P(None, ROW_AXIS, COL_AXIS),
+        check_vma=False,
+    )(A.rows, A.cols, B.rows)
+
+
+def summa_capacities(A: SpParMat, B: SpParMat, slack: float = 1.05):
+    """Host helper: symbolic pass → (flop_capacity, out_capacity).
+
+    flop_capacity = max single-stage single-tile expansion; out_capacity =
+    max per-tile total flops (a product has at most one output per flop),
+    clamped to the dense tile size. ``slack`` covers the float32 rounding of
+    the counts plus headroom for reusing compiled code across inputs.
+    """
+    per_stage = np.asarray(summa_stage_flops(A, B), dtype=np.float64)
+    flop_cap = max(int(per_stage.max() * slack) + 1, 1)
+    total_per_tile = per_stage.sum(axis=0).max()
+    dense_tile = A.local_rows * B.local_cols
+    out_cap = max(min(int(total_per_tile * slack) + 1, dense_tile), 1)
+    return flop_cap, out_cap
+
+
+def spgemm(sr: Semiring, A: SpParMat, B: SpParMat, slack: float = 1.05) -> SpParMat:
+    """Convenience: symbolic pass → sized numeric SUMMA (unjitted entry).
+
+    ≈ the user-facing ``Mult_AnXBn_Synch`` call; inside jit loops use
+    ``summa_spgemm`` with pre-chosen capacities instead.
+    """
+    flop_cap, out_cap = summa_capacities(A, B, slack)
+    return summa_spgemm(
+        sr, A, B, flop_capacity=flop_cap, out_capacity=out_cap
+    )

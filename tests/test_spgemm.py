@@ -1,0 +1,115 @@
+"""Local ESC SpGEMM and distributed SUMMA vs dense numpy products.
+
+Mirrors the reference's MultTest golden-product pattern
+(ReleaseTests/MultTest.cpp:122-234) with generated inputs.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from combblas_tpu import MIN_PLUS, OR_AND, PLUS_TIMES, SpTuples
+from combblas_tpu.ops.compressed import CSR
+from combblas_tpu.ops.spgemm import expand, flops, local_spgemm
+from combblas_tpu.parallel.grid import Grid
+from combblas_tpu.parallel.spgemm import spgemm, summa_capacities, summa_spgemm
+from combblas_tpu.parallel.spmat import SpParMat
+from conftest import random_dense
+
+
+def test_local_flops(rng):
+    da = random_dense(rng, 9, 7, 0.4)
+    db = random_dense(rng, 7, 11, 0.4)
+    a = SpTuples.from_dense(da, capacity=64)
+    b = CSR.from_tuples(SpTuples.from_dense(db, capacity=64))
+    expect = sum(
+        int((db[k] != 0).sum()) for i, k in zip(*np.nonzero(da))
+    )
+    assert int(flops(a, b)) == expect
+
+
+def test_local_spgemm_plus_times(rng):
+    da = random_dense(rng, 13, 9, 0.35)
+    db = random_dense(rng, 9, 10, 0.35)
+    a = SpTuples.from_dense(da, capacity=128)
+    b = CSR.from_tuples(SpTuples.from_dense(db, capacity=128))
+    fl = int(flops(a, b))
+    c = local_spgemm(PLUS_TIMES, a, b, flop_capacity=max(fl, 1), out_capacity=max(fl, 1))
+    np.testing.assert_allclose(np.asarray(c.to_dense()), da @ db, rtol=1e-5, atol=1e-6)
+
+
+def test_local_spgemm_min_plus(rng):
+    da = random_dense(rng, 6, 6, 0.5)
+    db = random_dense(rng, 6, 6, 0.5)
+    a = SpTuples.from_dense(da, capacity=36)
+    b = CSR.from_tuples(SpTuples.from_dense(db, capacity=36))
+    c = local_spgemm(MIN_PLUS, a, b, flop_capacity=64, out_capacity=64)
+    expect = np.full((6, 6), np.inf, np.float32)
+    for i in range(6):
+        for j in range(6):
+            for k in range(6):
+                if da[i, k] and db[k, j]:
+                    expect[i, j] = min(expect[i, j], da[i, k] + db[k, j])
+    got = np.asarray(c.to_dense(MIN_PLUS))
+    mask = ~np.isinf(expect)
+    np.testing.assert_allclose(got[mask], expect[mask], rtol=1e-6)
+
+
+@pytest.mark.parametrize("p", [1, 2])
+@pytest.mark.parametrize("ring", [False, True])
+def test_summa_vs_dense(p, ring, rng):
+    grid = Grid.make(p, p)
+    da = random_dense(rng, 21, 17, 0.25)
+    db = random_dense(rng, 17, 19, 0.25)
+    A = SpParMat.from_dense(grid, da)
+    B = SpParMat.from_dense(grid, db)
+    flop_cap, out_cap = summa_capacities(A, B)
+    C = summa_spgemm(
+        PLUS_TIMES, A, B,
+        flop_capacity=flop_cap, out_capacity=out_cap, ring=ring,
+    )
+    np.testing.assert_allclose(C.to_dense(), da @ db, rtol=1e-5, atol=1e-6)
+
+
+def test_summa_boolean_reachability(rng):
+    grid = Grid.make(2, 2)
+    da = (random_dense(rng, 16, 16, 0.15) != 0)
+    A = SpParMat.from_dense(grid, da.astype(np.float32))
+    A2 = spgemm(OR_AND, A.apply(lambda v: v != 0), A.apply(lambda v: v != 0))
+    expect = (da.astype(np.int32) @ da.astype(np.int32)) > 0
+    np.testing.assert_array_equal(A2.to_dense().astype(bool), expect)
+
+
+def test_summa_square_rmat(rng):
+    from combblas_tpu.utils.rmat import rmat_symmetric_coo
+
+    rows, cols = rmat_symmetric_coo(jax.random.key(11), scale=6, edgefactor=6)
+    n = 64
+    grid = Grid.make(2, 2)
+    A = SpParMat.from_global_coo(
+        grid, rows, cols, np.ones(len(rows), np.float32), n, n,
+        dedup_sr=PLUS_TIMES,
+    )
+    d = A.to_dense()
+    C = spgemm(PLUS_TIMES, A, A)
+    np.testing.assert_allclose(C.to_dense(), d @ d, rtol=1e-4, atol=1e-5)
+    # jitted with static capacities
+    flop_cap, out_cap = summa_capacities(A, A)
+    f = jax.jit(
+        lambda A, B: summa_spgemm(
+            PLUS_TIMES, A, B, flop_capacity=flop_cap, out_capacity=out_cap
+        )
+    )
+    np.testing.assert_allclose(f(A, A).to_dense(), d @ d, rtol=1e-4, atol=1e-5)
+
+
+def test_summa_rect_matrices_nonuniform(rng):
+    # shapes that don't divide the grid evenly
+    grid = Grid.make(2, 2)
+    da = random_dense(rng, 23, 15, 0.3)
+    db = random_dense(rng, 15, 27, 0.3)
+    A = SpParMat.from_dense(grid, da)
+    B = SpParMat.from_dense(grid, db)
+    C = spgemm(PLUS_TIMES, A, B)
+    np.testing.assert_allclose(C.to_dense(), da @ db, rtol=1e-5, atol=1e-6)
