@@ -1,0 +1,69 @@
+"""Maximal independent set — Luby's algorithm (≈ Applications/FilteredMIS.cpp).
+
+The reference's MIS driver runs Luby rounds with ``SpMV<Select2nd>`` and
+elementwise ops (``FilteredMIS.cpp``, SURVEY.md §2.5): each round every
+undecided vertex draws a random priority; vertices whose priority beats all
+undecided neighbors join the set, their neighbors leave.
+
+TPU-native expression: priorities are a random permutation of vertex ids
+(unique, so no tie handling), the neighborhood minimum is one SELECT2ND_MIN
+SpMV, and the "neighbor joined" test is a second SpMV over the candidate
+indicator — the whole loop is a ``lax.while_loop``, O(log n) expected rounds.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..semiring import SELECT2ND_MAX, SELECT2ND_MIN
+from ..parallel.spmat import SpParMat
+from ..parallel.spmv import dist_spmv
+from ..parallel.vec import DistVec
+
+UNDECIDED, IN_SET, EXCLUDED = 0, 1, -1
+
+
+@jax.jit
+def mis(A: SpParMat, key: jax.Array) -> tuple[DistVec, jax.Array]:
+    """Maximal independent set of the symmetric loop-free graph A.
+
+    Returns (status row-aligned int32: 1 = in set, -1 = excluded,
+    padding slots -1; iterations).
+    """
+    grid = A.grid
+    n = A.nrows
+
+    gids = DistVec.iota(grid, n, jnp.int32, align="row").blocks
+    pa, L = gids.shape
+    # Unique random priorities: a permutation of [0, pa*L).
+    prio = jax.random.permutation(key, pa * L).reshape(pa, L).astype(jnp.int32)
+    status0 = jnp.where(gids < n, UNDECIDED, EXCLUDED).astype(jnp.int32)
+
+    def mk(blocks):
+        return DistVec(blocks=blocks, length=n, align="row", grid=grid)
+
+    big = SELECT2ND_MIN.zero(jnp.int32)  # INT32_MAX
+
+    def cond(state):
+        sb, it = state
+        return jnp.any(sb == UNDECIDED) & (it < n)
+
+    def step(state):
+        sb, it = state
+        undecided = sb == UNDECIDED
+        # Priority of undecided vertices; decided ones are inert (+inf).
+        x = mk(jnp.where(undecided, prio, big)).realign("col")
+        nbr_min = dist_spmv(SELECT2ND_MIN, A, x)
+        cand = undecided & (prio < nbr_min.blocks)
+        # Neighbors of new set members become excluded.
+        ci = mk(jnp.where(cand, 1, -1)).realign("col")
+        nbr_cand = dist_spmv(SELECT2ND_MAX, A, ci)
+        sb = jnp.where(cand, IN_SET, sb)
+        sb = jnp.where(
+            (sb == UNDECIDED) & (nbr_cand.blocks == 1), EXCLUDED, sb
+        )
+        return sb, it + 1
+
+    sb, niter = jax.lax.while_loop(cond, step, (status0, jnp.int32(0)))
+    return mk(sb), niter

@@ -1,0 +1,55 @@
+"""Single-source shortest paths — Bellman-Ford over MIN_PLUS (≈ SSSP.cpp).
+
+The reference iterates ``SpMV<MinPlusSRing>`` until the distance vector
+stops improving (``Applications/SSSP.cpp`` main loop).  Identical here: the
+tropical semiring SpMV relaxes every edge each round; the loop is a
+``lax.while_loop`` fixed point, bounded by n rounds (longest possible
+shortest path), so one compiled program covers any source.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..semiring import MIN_PLUS
+from ..parallel.spmat import SpParMat
+from ..parallel.spmv import dist_spmv
+from ..parallel.vec import DistVec
+
+
+@jax.jit
+def sssp(A: SpParMat, source) -> tuple[DistVec, jax.Array]:
+    """Distances from ``source``; unreachable vertices hold +inf.
+
+    A[i, j] = w is the weight of edge j -> i (same gather orientation as
+    BFS); weights must be non-negative for meaningful results (Bellman-Ford
+    itself tolerates negatives but the fixed-point bound assumes no negative
+    cycles).  Returns (dist row-aligned float DistVec, iterations).
+    """
+    grid = A.grid
+    n = A.nrows
+    dtype = A.dtype
+    inf = MIN_PLUS.zero(dtype)
+
+    gids = DistVec.iota(grid, n, jnp.int32, align="row").blocks
+    d0 = jnp.where(gids == source, jnp.zeros((), dtype), inf)
+
+    def mk(blocks):
+        return DistVec(blocks=blocks, length=n, align="row", grid=grid)
+
+    def cond(state):
+        _, changed, it = state
+        return changed & (it < n)
+
+    def step(state):
+        db, _, it = state
+        d = mk(db)
+        relaxed = dist_spmv(MIN_PLUS, A, d.realign("col"))
+        nb = jnp.minimum(db, relaxed.blocks)
+        return nb, jnp.any(nb != db), it + 1
+
+    db, _, niter = jax.lax.while_loop(
+        cond, step, (d0, jnp.bool_(True), jnp.int32(0))
+    )
+    return mk(db), niter

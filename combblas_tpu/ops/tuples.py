@@ -238,6 +238,16 @@ class SpTuples:
         keep = self.valid_mask() & ~pred(self.vals)
         return self._select(keep)
 
+    def select_ij(self, keep_fn) -> "SpTuples":
+        """Keep entries where ``keep_fn(row, col)`` (tile-local ids) is True.
+
+        The structural counterpart of ``prune``: used for tril/triu/
+        RemoveLoops (reference ``SpParMat::PruneI`` / ``RemoveLoops``,
+        SpParMat.cpp:3257).
+        """
+        keep = self.valid_mask() & keep_fn(self.rows, self.cols)
+        return self._select(keep)
+
     def _select(self, keep: Array) -> "SpTuples":
         """Stable-compact entries where ``keep`` to the front."""
         cap = self.capacity

@@ -125,6 +125,43 @@ class SpParMat:
         )(self.rows, self.cols, self.vals, self.nnz)
         return dataclasses.replace(ref, rows=r, cols=c, vals=v, nnz=n)
 
+    def tile_map_indexed(self, fn) -> "SpParMat":
+        """Apply ``fn(tile, row_offset, col_offset) -> tile`` per tile.
+
+        Offsets are the tile's global (row, col) origin, computed from the
+        mesh position — how a local kernel learns its place in the global
+        matrix (the reference threads this through CommGrid rank math).
+        """
+        lr, lc = self.local_rows, self.local_cols
+        return self.tile_map(
+            lambda t: fn(
+                t,
+                (lax.axis_index(ROW_AXIS) * lr).astype(jnp.int32),
+                (lax.axis_index(COL_AXIS) * lc).astype(jnp.int32),
+            )
+        )
+
+    def keep_ij(self, pred) -> "SpParMat":
+        """Keep entries where ``pred(global_row, global_col)`` is True.
+
+        Reference: ``SpParMat::PruneI`` (index-based prune family)."""
+        return self.tile_map_indexed(
+            lambda t, ro, co: t.select_ij(lambda r, c: pred(r + ro, c + co))
+        )
+
+    def tril(self, strict: bool = True) -> "SpParMat":
+        """Lower-triangular part (strict by default — the TC mask,
+        ``TC.cpp:104``)."""
+        return self.keep_ij((lambda r, c: r > c) if strict else (lambda r, c: r >= c))
+
+    def triu(self, strict: bool = True) -> "SpParMat":
+        return self.keep_ij((lambda r, c: r < c) if strict else (lambda r, c: r <= c))
+
+    def remove_loops(self) -> "SpParMat":
+        """Drop diagonal entries. Reference: ``SpParMat::RemoveLoops``
+        (SpParMat.cpp:3257)."""
+        return self.keep_ij(lambda r, c: r != c)
+
     # --- construction -----------------------------------------------------
 
     @staticmethod

@@ -33,6 +33,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ..ops.segment import segment_reduce
 from ..semiring import Semiring
 from .collectives import axis_reduce
 from .grid import COL_AXIS, ROW_AXIS, Grid
@@ -151,6 +152,51 @@ class DistVec:
             self,
             blocks=jnp.where(gids < self.length, self.blocks, fill),
         )
+
+    # --- indirect addressing (the FullyDistVec subsref/ReduceAssign pair) --
+
+    def gather(self, idx: "DistVec") -> "DistVec":
+        """out[k] = self[idx[k]] — distributed vector subscript.
+
+        Reference: ``FullyDistVec::operator()(FullyDistVec ri)`` (subsref,
+        FullyDistVec.cpp) — there an Alltoallv request/response exchange; here
+        a plain sharded gather, with GSPMD inserting the all-gather of
+        ``self`` over ICI.  idx values must lie in [0, self.length); anything
+        else (including idx's own padding slots) reads an unspecified slot —
+        callers must mask those results.  Result is aligned like ``idx``.
+        """
+        full = self.blocks.reshape(-1)
+        safe = jnp.clip(idx.blocks, 0, full.shape[0] - 1)
+        return DistVec(
+            blocks=full[safe],
+            length=idx.length,
+            align=idx.align,
+            grid=idx.grid,
+        )
+
+    def scatter_combine(
+        self, sr: Semiring, idx: "DistVec", src: "DistVec"
+    ) -> "DistVec":
+        """out[p] = sr.add(self[p], ⊕{src[k] : idx[k] == p}).
+
+        Reference: ``FullyDistVec::ReduceAssign`` / the scatter helper used
+        by LACC & FastSV hooking (CC.h:1033-1230, FastSV.h:68-146) — there an
+        Alltoallv of (index, value) pairs + local fold; here one segment
+        reduction over the flattened blocks (identity-filled empty segments
+        make the final elementwise ``add`` a no-op for untouched slots).
+        idx/src must share alignment and shape with each other; padding slots
+        of idx (beyond idx.length) are dropped.
+        """
+        assert idx.align == src.align and idx.length == src.length
+        pa, L = self.blocks.shape
+        ids = idx.blocks.reshape(-1)
+        vals = src.blocks.reshape(-1)
+        pos = jnp.arange(ids.shape[0], dtype=jnp.int32)
+        ids = jnp.where(pos < idx.length, ids, pa * L)  # drop padding sources
+        ids = jnp.where((ids >= 0) & (ids < self.length), ids, pa * L)
+        contrib = segment_reduce(sr, vals, ids, pa * L)
+        out = sr.add(self.blocks.reshape(-1), contrib)
+        return dataclasses.replace(self, blocks=out.reshape(pa, L))
 
     def reduce(self, sr: Semiring) -> Array:
         """Global fold with sr.add → replicated scalar.
