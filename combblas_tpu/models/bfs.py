@@ -27,7 +27,7 @@ import jax
 import jax.numpy as jnp
 
 from ..semiring import PLUS_TIMES, SELECT2ND_MAX
-from ..parallel.spmat import SpParMat
+from ..parallel.spmat import SpParMat, ones_i32
 from ..parallel.spmv import dist_spmv_masked
 from ..parallel.vec import DistVec
 
@@ -97,7 +97,7 @@ def traversed_edges(A: SpParMat, parents: DistVec) -> jax.Array:
     Matches the TEPS accounting of ``TopDownBFS.cpp:448-465`` for
     symmetrized graphs (each undirected edge stored twice).
     """
-    deg = A.reduce(PLUS_TIMES, axis="cols", map_fn=lambda v: jnp.ones_like(v, jnp.int32))
+    deg = A.reduce(PLUS_TIMES, axis="cols", map_fn=ones_i32)
     disc = parents.realign("row").blocks >= 0
     return jnp.sum(jnp.where(disc, deg.blocks, 0)) // 2
 

@@ -16,9 +16,13 @@ import jax
 import jax.numpy as jnp
 
 from ..semiring import PLUS_TIMES
-from ..parallel.spmat import SpParMat
+from ..parallel.spmat import SpParMat, ones_f32
 from ..parallel.spmv import dist_spmv
 from ..parallel.vec import DistVec
+
+
+def _scale(a, s):
+    return a * s
 
 
 @partial(jax.jit, static_argnames=("alpha", "tol", "max_iters"))
@@ -36,16 +40,12 @@ def pagerank(
     grid = A.grid
     n = A.nrows
     # Out-degree of j = # entries in column j (structural).
-    outdeg = A.reduce(
-        PLUS_TIMES, axis="rows", map_fn=lambda v: jnp.ones_like(v, jnp.float32)
-    )
+    outdeg = A.reduce(PLUS_TIMES, axis="rows", map_fn=ones_f32)
     inv_deg = outdeg.apply(
         lambda d: jnp.where(d > 0, 1.0 / jnp.maximum(d, 1.0), 0.0)
     )
     # Column-stochastic scale: P[i,j] = A[i,j] / outdeg[j] (structure-wise).
-    P = A.apply(lambda v: jnp.ones_like(v, jnp.float32)).dim_apply(
-        inv_deg, lambda a, s: a * s, axis="cols"
-    )
+    P = A.apply(ones_f32).dim_apply(inv_deg, _scale, axis="cols")
     dangling = outdeg.apply(lambda d: (d == 0).astype(jnp.float32))
     # Mask padding columns out of the dangling-mass sum.
     col_gids = DistVec.iota(grid, n, jnp.int32, align="col").blocks

@@ -13,7 +13,7 @@ import jax.numpy as jnp
 
 from ..semiring import PLUS_TIMES
 from ..parallel.spgemm import spgemm, summa_spgemm
-from ..parallel.spmat import SpParMat
+from ..parallel.spmat import SpParMat, ones_f32
 
 
 def triangle_count(A: SpParMat) -> int:
@@ -21,9 +21,7 @@ def triangle_count(A: SpParMat) -> int:
     loop-free nonzero structure). Unjitted entry: runs the distributed
     symbolic pass to size the SpGEMM, then the compiled numeric pass.
     """
-    L = A.remove_loops().tril(strict=True).apply(
-        lambda v: jnp.ones_like(v, jnp.float32)
-    )
+    L = A.remove_loops().tril(strict=True).apply(ones_f32)
     B = spgemm(PLUS_TIMES, L, L)  # B[i,j] = # wedges i->k->j with i>k>j
     C = B.ewise_mult(L)  # keep wedge counts only where edge (i,j) closes
     colsums = C.reduce(PLUS_TIMES, axis="rows")

@@ -29,6 +29,7 @@ distributed symbolic pass before jitting the numeric one.
 from __future__ import annotations
 
 import dataclasses
+from functools import partial
 
 import jax
 import jax.numpy as jnp
@@ -71,6 +72,10 @@ def _gather_stage_tiles(t: SpTuples, axis_name, p: int) -> list[SpTuples]:
     ]
 
 
+@partial(
+    jax.jit,
+    static_argnames=("sr", "flop_capacity", "out_capacity", "ring"),
+)
 def summa_spgemm(
     sr: Semiring,
     A: SpParMat,
@@ -163,6 +168,7 @@ def summa_spgemm(
     )
 
 
+@jax.jit
 def summa_stage_flops(A: SpParMat, B: SpParMat) -> jax.Array:
     """[p, pr, pc] float32 flop count per stage per output tile.
 
@@ -218,13 +224,60 @@ def summa_capacities(A: SpParMat, B: SpParMat, slack: float = 1.05):
     return flop_cap, out_cap
 
 
-def spgemm(sr: Semiring, A: SpParMat, B: SpParMat, slack: float = 1.05) -> SpParMat:
+def mem_efficient_spgemm(
+    sr: Semiring,
+    A: SpParMat,
+    B: SpParMat,
+    phases: int,
+    *,
+    slack: float = 1.05,
+    prune_fn=None,
+) -> SpParMat:
+    """Phased SUMMA: C = A ⊗ B computed over column chunks of B.
+
+    Reference: ``MemEfficientSpGEMM`` (ParFriends.h:450-731) — B is
+    ``ColSplit`` into ``phases`` local column chunks; each phase runs a full
+    SUMMA plus an optional ``prune_fn`` hook (MCL's prune/recover/select,
+    ParFriends.h:186-350), and phase outputs concatenate back. Peak expansion
+    memory drops ~``phases``-fold at the cost of re-gathering A every phase.
+    The reference auto-computes ``phases`` from a memory budget via
+    ``EstPerProcessNnzSUMMA``; here the symbolic pass inside ``spgemm`` sizes
+    each phase exactly, so callers choose ``phases`` directly.
+    """
+    if phases <= 1:
+        C = spgemm(sr, A, B, slack)
+        return prune_fn(C) if prune_fn is not None else C
+    outs = []
+    for Bs in B.col_split(phases):
+        C = spgemm(sr, A, Bs, slack)
+        if prune_fn is not None:
+            C = prune_fn(C)
+        outs.append(C)
+    return SpParMat.col_concatenate(outs)
+
+
+def spgemm(
+    sr: Semiring,
+    A: SpParMat,
+    B: SpParMat,
+    slack: float = 1.05,
+    *,
+    pow2_caps: bool = True,
+) -> SpParMat:
     """Convenience: symbolic pass → sized numeric SUMMA (unjitted entry).
 
     ≈ the user-facing ``Mult_AnXBn_Synch`` call; inside jit loops use
     ``summa_spgemm`` with pre-chosen capacities instead.
+
+    ``pow2_caps`` rounds both capacities up to powers of two (≤2× memory
+    slack) so iterative callers (MCL's expand loop, BC's per-level products)
+    hit the XLA compilation cache instead of recompiling for every new nnz.
     """
     flop_cap, out_cap = summa_capacities(A, B, slack)
+    if pow2_caps:
+        dense_tile = A.local_rows * B.local_cols
+        flop_cap = 1 << (flop_cap - 1).bit_length()
+        out_cap = min(1 << (out_cap - 1).bit_length(), max(dense_tile, 1))
     return summa_spgemm(
         sr, A, B, flop_capacity=flop_cap, out_capacity=out_cap
     )

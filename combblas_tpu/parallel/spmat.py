@@ -12,22 +12,32 @@ local kernel, keep the schedule.
 
 Tile-local indices are int32; padding slots hold (local_rows, local_cols).
 Global dims are padded to ceil-multiples of the grid shape (see grid.py).
+
+COMPILATION-CACHE DISCIPLINE: every distributed op dispatches through a
+module-level ``jax.jit``-wrapped impl whose non-array parameters (semiring,
+axis, capacities, user callbacks) are static arguments.  Repeated calls with
+the same shapes then reuse the compiled executable — the analog of the
+reference's one-time template instantiation, and essential for iterative
+drivers (MCL, BC, BFS sweeps) that would otherwise re-trace and re-compile
+every iteration.  Callers supplying callbacks (``apply``/``prune``/
+``reduce(map_fn=...)``) should pass module-level functions (not fresh
+lambdas) to benefit.
 """
 
 from __future__ import annotations
 
 import dataclasses
-from functools import partial
+from functools import lru_cache, partial
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
-from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
 
 from ..ops.segment import segment_reduce
 from ..ops.tuples import SpTuples
-from ..semiring import Semiring
+from ..semiring import Semiring, _minval
 from .collectives import axis_reduce
 from .grid import COL_AXIS, ROW_AXIS, Grid
 from .vec import DistVec
@@ -35,6 +45,45 @@ from .vec import DistVec
 Array = jax.Array
 
 TILE_SPEC = P(ROW_AXIS, COL_AXIS)
+
+
+def _monotone_key_u32(v: Array) -> Array:
+    """Order-preserving map of a 32-bit value array onto uint32 keys.
+
+    The radix-select substrate for ``kselect``: float32 uses the sign-flip
+    trick (negative floats bit-invert, positives set the MSB), signed ints
+    XOR the sign bit, bools/unsigned cast. Total order matches the value
+    order, so threshold search can run in integer bit-space exactly.
+    """
+    dtype = jnp.dtype(v.dtype)
+    if dtype == jnp.bool_:
+        return v.astype(jnp.uint32)
+    if jnp.issubdtype(dtype, jnp.floating):
+        assert dtype.itemsize == 4, "kselect supports 32-bit dtypes"
+        u = lax.bitcast_convert_type(v, jnp.uint32)
+        mask = jnp.where(
+            (u >> 31) != 0, jnp.uint32(0xFFFFFFFF), jnp.uint32(0x80000000)
+        )
+        return u ^ mask
+    if jnp.issubdtype(dtype, jnp.signedinteger):
+        assert dtype.itemsize == 4, "kselect supports 32-bit dtypes"
+        return lax.bitcast_convert_type(v, jnp.uint32) ^ jnp.uint32(0x80000000)
+    return v.astype(jnp.uint32)
+
+
+def _u32_key_to_val(key: Array, dtype) -> Array:
+    """Inverse of ``_monotone_key_u32``."""
+    dtype = jnp.dtype(dtype)
+    if dtype == jnp.bool_:
+        return key.astype(jnp.bool_)
+    if jnp.issubdtype(dtype, jnp.floating):
+        mask = jnp.where(
+            (key >> 31) != 0, jnp.uint32(0x80000000), jnp.uint32(0xFFFFFFFF)
+        )
+        return lax.bitcast_convert_type(key ^ mask, dtype)
+    if jnp.issubdtype(dtype, jnp.signedinteger):
+        return lax.bitcast_convert_type(key ^ jnp.uint32(0x80000000), dtype)
+    return key.astype(dtype)
 
 
 @partial(
@@ -109,21 +158,13 @@ class SpParMat:
         """Apply ``fn: SpTuples -> SpTuples`` to every tile (no comm).
 
         The local-kernel dispatch boundary — the analog of calling into the
-        DER layer from SpParMat methods.
+        DER layer from SpParMat methods. For compile-cache hits pass a
+        module-level ``fn``.
         """
-        ref = out_like if out_like is not None else self
-
-        def body(rows, cols, vals, nnz):
-            out = fn(self.local_tile(rows, cols, vals, nnz))
-            return SpParMat._pack_tile(out)
-
-        r, c, v, n = jax.shard_map(
-            body,
-            mesh=self.grid.mesh,
-            in_specs=(TILE_SPEC, TILE_SPEC, TILE_SPEC, TILE_SPEC),
-            out_specs=(TILE_SPEC, TILE_SPEC, TILE_SPEC, TILE_SPEC),
-        )(self.rows, self.cols, self.vals, self.nnz)
-        return dataclasses.replace(ref, rows=r, cols=c, vals=v, nnz=n)
+        meta = (
+            (out_like.nrows, out_like.ncols) if out_like is not None else None
+        )
+        return _tile_map_jit(self, fn, out_meta=meta, indexed=False)
 
     def tile_map_indexed(self, fn) -> "SpParMat":
         """Apply ``fn(tile, row_offset, col_offset) -> tile`` per tile.
@@ -132,35 +173,26 @@ class SpParMat:
         mesh position — how a local kernel learns its place in the global
         matrix (the reference threads this through CommGrid rank math).
         """
-        lr, lc = self.local_rows, self.local_cols
-        return self.tile_map(
-            lambda t: fn(
-                t,
-                (lax.axis_index(ROW_AXIS) * lr).astype(jnp.int32),
-                (lax.axis_index(COL_AXIS) * lc).astype(jnp.int32),
-            )
-        )
+        return _tile_map_jit(self, fn, out_meta=None, indexed=True)
 
     def keep_ij(self, pred) -> "SpParMat":
         """Keep entries where ``pred(global_row, global_col)`` is True.
 
         Reference: ``SpParMat::PruneI`` (index-based prune family)."""
-        return self.tile_map_indexed(
-            lambda t, ro, co: t.select_ij(lambda r, c: pred(r + ro, c + co))
-        )
+        return _keep_ij_jit(self, pred)
 
     def tril(self, strict: bool = True) -> "SpParMat":
         """Lower-triangular part (strict by default — the TC mask,
         ``TC.cpp:104``)."""
-        return self.keep_ij((lambda r, c: r > c) if strict else (lambda r, c: r >= c))
+        return self.keep_ij(_pred_tril_strict if strict else _pred_tril)
 
     def triu(self, strict: bool = True) -> "SpParMat":
-        return self.keep_ij((lambda r, c: r < c) if strict else (lambda r, c: r <= c))
+        return self.keep_ij(_pred_triu_strict if strict else _pred_triu)
 
     def remove_loops(self) -> "SpParMat":
         """Drop diagonal entries. Reference: ``SpParMat::RemoveLoops``
         (SpParMat.cpp:3257)."""
-        return self.keep_ij(lambda r, c: r != c)
+        return self.keep_ij(_pred_offdiag)
 
     # --- construction -----------------------------------------------------
 
@@ -219,7 +251,7 @@ class SpParMat:
             grid=grid,
         )
         if dedup_sr is not None:
-            mat = mat.tile_map(lambda t: t.compact(dedup_sr))
+            mat = mat.tile_map(_compact_fn(dedup_sr))
         return mat
 
     @staticmethod
@@ -242,10 +274,13 @@ class SpParMat:
         out_r, out_c, out_v = [], [], []
         for i in range(self.grid.pr):
             for j in range(self.grid.pc):
-                n = N[i, j]
-                out_r.append(R[i, j, :n].astype(np.int64) + i * lr)
-                out_c.append(C[i, j, :n].astype(np.int64) + j * lc)
-                out_v.append(V[i, j, :n])
+                # Mask- rather than prefix-based: tiles need not be compacted
+                # (e.g. right after concat-style ops like add_loops).
+                m = R[i, j] < lr
+                assert m.sum() == N[i, j]
+                out_r.append(R[i, j, m].astype(np.int64) + i * lr)
+                out_c.append(C[i, j, m].astype(np.int64) + j * lc)
+                out_v.append(V[i, j, m])
         return (
             np.concatenate(out_r), np.concatenate(out_c), np.concatenate(out_v),
         )
@@ -260,11 +295,11 @@ class SpParMat:
 
     def apply(self, fn) -> "SpParMat":
         """Reference: ``SpParMat::Apply`` (SpParMat.h:148)."""
-        return self.tile_map(lambda t: t.apply(fn))
+        return _apply_jit(self, fn)
 
     def prune(self, pred) -> "SpParMat":
         """Drop entries where pred(val). Reference: ``SpParMat::Prune``."""
-        return self.tile_map(lambda t: t.prune(pred))
+        return _prune_jit(self, pred)
 
     def ewise_mult(
         self, other: "SpParMat", negate: bool = False, combine=None
@@ -276,29 +311,104 @@ class SpParMat:
         """
         assert self.grid == other.grid
         assert (self.nrows, self.ncols) == (other.nrows, other.ncols)
-        from ..ops.ewise import ewise_mult as _ewise_mult
+        return _ewise_mult_jit(self, other, negate, combine)
 
-        return self._tile_zip(
-            lambda a, b: _ewise_mult(a, b, negate=negate, combine=combine), other
+    # --- elementwise union add (matrix +) ---------------------------------
+
+    def ewise_add(
+        self, other: "SpParMat", sr: Semiring, capacity: int | None = None
+    ) -> "SpParMat":
+        """C = A ⊕ B elementwise union: entries present in both are combined
+        with ``sr.add``.
+
+        Reference: ``SpParMat::operator+=`` (SpParMat.cpp:741) — there a
+        local Dcsc merge; here a slot-array concat + compact (tiles align
+        because grids and dims match, so no communication). Output capacity
+        defaults to the sum of input capacities.
+        """
+        assert self.grid == other.grid
+        assert (self.nrows, self.ncols) == (other.nrows, other.ncols)
+        return _ewise_add_jit(self, other, sr, capacity)
+
+    def add_loops(self, value) -> "SpParMat":
+        """Set every diagonal entry to ``value`` (replacing any existing).
+
+        Reference: ``SpParMat::AddLoops`` (SpParMat.cpp:3300-3341). Requires
+        square blocking (local_rows == local_cols) so the diagonal lives in
+        the (i,i) tiles. Output capacity grows by local_rows slots.
+        """
+        assert self.local_rows == self.local_cols, (
+            "add_loops requires square blocking"
         )
+        return _add_loops_jit(self, jnp.asarray(value, self.dtype))
 
-    def _tile_zip(self, fn, other: "SpParMat") -> "SpParMat":
-        def body(ar, ac, av, an, br, bc, bv, bn):
-            a = self.local_tile(ar, ac, av, an)
-            b = other.local_tile(br, bc, bv, bn)
-            return SpParMat._pack_tile(fn(a, b))
+    # --- per-column select / prune (the MCL support ops) -------------------
 
-        specs = (TILE_SPEC,) * 8
-        r, c, v, n = jax.shard_map(
-            body,
-            mesh=self.grid.mesh,
-            in_specs=specs,
-            out_specs=(TILE_SPEC,) * 4,
-        )(
-            self.rows, self.cols, self.vals, self.nnz,
-            other.rows, other.cols, other.vals, other.nnz,
+    def nnz_per_column(self) -> DistVec:
+        """Col-aligned int32 vector of per-column nonzero counts.
+
+        Reference: ``Reduce(Column, plus, 1)`` as used by
+        MCLPruneRecoverySelect (ParFriends.h:186-350).
+        """
+        from ..semiring import PLUS_TIMES
+
+        return self.reduce(PLUS_TIMES, "rows", map_fn=_ones_i32)
+
+    def kselect(self, k) -> DistVec:
+        """Per-column k-th largest value, as a col-aligned threshold vector.
+
+        Reference: ``SpParMat::Kselect1`` (SpParMat.cpp:1120-1742) — there a
+        chunked column gather + median-of-medians (TopKGather); here a
+        radix-select over order-preserving 32-bit keys: 32 rounds of
+        (per-column segment count + psum over the grid-row axis), fully
+        jittable and free of data-dependent shapes.
+
+        Columns with fewer than k entries get the dtype's minimum value
+        ("keep everything" under a >= threshold test).  ``k`` is a positive
+        int or a col-aligned int32 DistVec of per-column k's.
+        """
+        if isinstance(k, DistVec):
+            return _kselect_jit(self, None, k.realign("col"))
+        return _kselect_jit(self, int(k), None)
+
+    def prune_column(self, vec: DistVec, keep) -> "SpParMat":
+        """Keep entry (i,j) iff ``keep(val, vec[j])``.
+
+        Reference: ``SpParMat::PruneColumn`` (SpParMat.cpp:2567-2779), with
+        the predicate expressed as *keep* instead of prune.
+        """
+        return _prune_column_jit(self, vec.realign("col"), keep)
+
+    # --- local column split / concat (phased execution) --------------------
+
+    def col_split(self, nsplits: int) -> list["SpParMat"]:
+        """Split into ``nsplits`` matrices, each holding every tile's s-th
+        local column chunk.
+
+        Reference: ``SpDCCols::ColSplit`` (SpDCCols.h:286, dcsc.h:103) — the
+        phase splitter of MemEfficientSpGEMM (ParFriends.h:550-553). Like the
+        reference, the split is LOCAL: globally the s-th output holds a
+        strided family of column blocks, and ``col_concatenate`` restores the
+        original order.  Requires no column padding and lc % nsplits == 0.
+        """
+        lc = self.local_cols
+        assert self.ncols == lc * self.grid.pc, (
+            "col_split requires ncols to divide evenly over the grid"
         )
-        return dataclasses.replace(self, rows=r, cols=c, vals=v, nnz=n)
+        assert lc % nsplits == 0, f"local cols {lc} not divisible by {nsplits}"
+        return list(_col_split_jit(self, nsplits))
+
+    @staticmethod
+    def col_concatenate(mats: list["SpParMat"]) -> "SpParMat":
+        """Stitch ``col_split`` pieces (or phase outputs) back together.
+
+        Reference: ``SpDCCols::ColConcatenate`` — the phase-output stitching
+        of MemEfficientSpGEMM (ParFriends.h:700-720). Local-only; output
+        capacity is the sum of piece capacities (not compacted).
+        """
+        ncols = sum(m.ncols for m in mats)
+        assert ncols == sum(m.local_cols for m in mats) * mats[0].grid.pc
+        return _col_concat_jit(tuple(mats))
 
     # --- reductions -------------------------------------------------------
 
@@ -309,31 +419,10 @@ class SpParMat:
                      (reference Reduce(Column), SpParMat.cpp:888-1119).
         axis="cols": fold each row's entries → row-aligned vec[nrows]
                      (reference Reduce(Row)).
-        map_fn transforms values before folding (the reference's __unary_op).
+        map_fn transforms values before folding (the reference's __unary_op);
+        pass a module-level function for compile-cache hits.
         """
-        lr, lc = self.local_rows, self.local_cols
-        out_len = self.ncols if axis == "rows" else self.nrows
-        align = "col" if axis == "rows" else "row"
-        comm_axis = ROW_AXIS if axis == "rows" else COL_AXIS
-        seg_n = lc if axis == "rows" else lr
-
-        def body(rows, cols, vals, nnz):
-            t = self.local_tile(rows, cols, vals, nnz)
-            v = map_fn(t.vals) if map_fn is not None else t.vals
-            ids = t.cols if axis == "rows" else t.rows
-            local = segment_reduce(sr, v, ids, seg_n)
-            return axis_reduce(sr, local, comm_axis)[None]
-
-        out_specs = P(COL_AXIS) if axis == "rows" else P(ROW_AXIS)
-        blocks = jax.shard_map(
-            body,
-            mesh=self.grid.mesh,
-            in_specs=(TILE_SPEC,) * 4,
-            out_specs=out_specs,
-        )(self.rows, self.cols, self.vals, self.nnz)
-        return DistVec(
-            blocks=blocks, length=out_len, align=align, grid=self.grid
-        )
+        return _reduce_jit(self, sr, axis, map_fn)
 
     # --- transpose --------------------------------------------------------
 
@@ -345,27 +434,8 @@ class SpParMat:
         both mesh axes. Square grids only (as is effectively true of the
         reference's vector-compatible usage).
         """
-        grid = self.grid
-        assert grid.is_square, "transpose requires a square grid"
-        perm = grid.transpose_perm()
-
-        def body(rows, cols, vals, nnz):
-            t = self.local_tile(rows, cols, vals, nnz).transpose()
-            packed = SpParMat._pack_tile(t)
-            return tuple(
-                lax.ppermute(x, (ROW_AXIS, COL_AXIS), perm) for x in packed
-            )
-
-        r, c, v, n = jax.shard_map(
-            body,
-            mesh=grid.mesh,
-            in_specs=(TILE_SPEC,) * 4,
-            out_specs=(TILE_SPEC,) * 4,
-        )(self.rows, self.cols, self.vals, self.nnz)
-        return SpParMat(
-            rows=r, cols=c, vals=v, nnz=n,
-            nrows=self.ncols, ncols=self.nrows, grid=grid,
-        )
+        assert self.grid.is_square, "transpose requires a square grid"
+        return _transpose_jit(self)
 
     # --- scaling by distributed vectors -----------------------------------
 
@@ -377,26 +447,372 @@ class SpParMat:
         axis="rows": entry (i,j) ← fn(val, vec[i]) with row-aligned vec.
         """
         want_align = "col" if axis == "cols" else "row"
-        vec = vec.realign(want_align)
-        vspec = P(COL_AXIS) if axis == "cols" else P(ROW_AXIS)
+        return _dim_apply_jit(self, vec.realign(want_align), fn, axis)
 
-        def body(rows, cols, vals, nnz, vblk):
-            t = self.local_tile(rows, cols, vals, nnz)
-            v = vblk[0]
-            vpad = jnp.concatenate([v, jnp.zeros((1,), v.dtype)])
-            idx = t.cols if axis == "cols" else t.rows
-            idx = jnp.minimum(idx, v.shape[0])
-            new_vals = jnp.where(
-                t.valid_mask(), fn(t.vals, vpad[idx]), t.vals
+
+# --- module-level predicates / tile fns (stable identities for jit cache) --
+
+
+def _pred_tril_strict(r, c):
+    return r > c
+
+
+def _pred_tril(r, c):
+    return r >= c
+
+
+def _pred_triu_strict(r, c):
+    return r < c
+
+
+def _pred_triu(r, c):
+    return r <= c
+
+
+def _pred_offdiag(r, c):
+    return r != c
+
+
+def ones_i32(v):
+    """Structural-one map for ``reduce(map_fn=...)`` / ``apply`` callers.
+
+    Module-level so repeated calls share one jit-cache entry (see the
+    compilation-cache discipline note in the module docstring).
+    """
+    return jnp.ones(v.shape, jnp.int32)
+
+
+def ones_f32(v):
+    return jnp.ones(v.shape, jnp.float32)
+
+
+_ones_i32 = ones_i32
+
+
+@lru_cache(maxsize=None)
+def _compact_fn(sr: Semiring, capacity: int | None = None):
+    def f(t: SpTuples) -> SpTuples:
+        return t.compact(sr, capacity=capacity)
+
+    return f
+
+
+# --- jitted impls ----------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=("fn", "out_meta", "indexed"))
+def _tile_map_jit(
+    mat: SpParMat, fn, out_meta=None, indexed: bool = False
+) -> SpParMat:
+    nrows, ncols = out_meta if out_meta is not None else (mat.nrows, mat.ncols)
+    lr, lc = mat.local_rows, mat.local_cols
+
+    def body(rows, cols, vals, nnz):
+        t = mat.local_tile(rows, cols, vals, nnz)
+        if indexed:
+            out = fn(
+                t,
+                (lax.axis_index(ROW_AXIS) * lr).astype(jnp.int32),
+                (lax.axis_index(COL_AXIS) * lc).astype(jnp.int32),
             )
-            return SpParMat._pack_tile(
-                dataclasses.replace(t, vals=new_vals)
+        else:
+            out = fn(t)
+        return SpParMat._pack_tile(out)
+
+    r, c, v, n = jax.shard_map(
+        body,
+        mesh=mat.grid.mesh,
+        in_specs=(TILE_SPEC,) * 4,
+        out_specs=(TILE_SPEC,) * 4,
+    )(mat.rows, mat.cols, mat.vals, mat.nnz)
+    return dataclasses.replace(
+        mat, rows=r, cols=c, vals=v, nnz=n, nrows=nrows, ncols=ncols
+    )
+
+
+@partial(jax.jit, static_argnames=("pred",))
+def _keep_ij_jit(mat: SpParMat, pred) -> SpParMat:
+    def f(t, ro, co):
+        return t.select_ij(lambda r, c: pred(r + ro, c + co))
+
+    return _tile_map_jit(mat, f, indexed=True)
+
+
+@partial(jax.jit, static_argnames=("fn",))
+def _apply_jit(mat: SpParMat, fn) -> SpParMat:
+    return _tile_map_jit(mat, lambda t: t.apply(fn))
+
+
+@partial(jax.jit, static_argnames=("pred",))
+def _prune_jit(mat: SpParMat, pred) -> SpParMat:
+    return _tile_map_jit(mat, lambda t: t.prune(pred))
+
+
+@partial(jax.jit, static_argnames=("negate", "combine"))
+def _ewise_mult_jit(
+    a: SpParMat, b: SpParMat, negate: bool, combine
+) -> SpParMat:
+    from ..ops.ewise import ewise_mult as _ewise_mult
+
+    return _tile_zip_jit(
+        a, b, _EwiseMultFn(negate, combine)
+    )
+
+
+class _EwiseMultFn:
+    """Hashable wrapper so (negate, combine) pairs key the jit cache."""
+
+    def __init__(self, negate, combine):
+        self.negate, self.combine = negate, combine
+
+    def __call__(self, x, y):
+        from ..ops.ewise import ewise_mult as _ewise_mult
+
+        return _ewise_mult(x, y, negate=self.negate, combine=self.combine)
+
+    def __hash__(self):
+        return hash(("_EwiseMultFn", self.negate, self.combine))
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, _EwiseMultFn)
+            and (self.negate, self.combine) == (other.negate, other.combine)
+        )
+
+
+@partial(jax.jit, static_argnames=("fn",))
+def _tile_zip_jit(a: SpParMat, b: SpParMat, fn) -> SpParMat:
+    def body(ar, ac, av, an, br, bc, bv, bn):
+        ta = a.local_tile(ar, ac, av, an)
+        tb = b.local_tile(br, bc, bv, bn)
+        return SpParMat._pack_tile(fn(ta, tb))
+
+    r, c, v, n = jax.shard_map(
+        body,
+        mesh=a.grid.mesh,
+        in_specs=(TILE_SPEC,) * 8,
+        out_specs=(TILE_SPEC,) * 4,
+    )(a.rows, a.cols, a.vals, a.nnz, b.rows, b.cols, b.vals, b.nnz)
+    return dataclasses.replace(a, rows=r, cols=c, vals=v, nnz=n)
+
+
+@partial(jax.jit, static_argnames=("sr", "capacity"))
+def _ewise_add_jit(
+    a: SpParMat, b: SpParMat, sr: Semiring, capacity: int | None
+) -> SpParMat:
+    comb = dataclasses.replace(
+        a,
+        rows=jnp.concatenate([a.rows, b.rows], axis=2),
+        cols=jnp.concatenate([a.cols, b.cols], axis=2),
+        vals=jnp.concatenate([a.vals, b.vals], axis=2),
+        nnz=a.nnz + b.nnz,
+    )
+    return _tile_map_jit(comb, _compact_fn(sr, capacity))
+
+
+@jax.jit
+def _add_loops_jit(mat: SpParMat, value) -> SpParMat:
+    lr, lc = mat.local_rows, mat.local_cols
+    ndiag = min(mat.nrows, mat.ncols)
+    dtype = mat.dtype
+
+    def f(t: SpTuples, ro, co):
+        base = t.select_ij(lambda r, c: (r + ro) != (c + co))
+        d = jnp.arange(lr, dtype=jnp.int32)
+        ok = (ro == co) & ((d + ro) < ndiag)
+        extra = SpTuples(
+            rows=jnp.where(ok, d, lr),
+            cols=jnp.where(ok, d, lc),
+            vals=jnp.full((lr,), value, dtype),
+            nnz=jnp.sum(ok).astype(jnp.int32),
+            nrows=t.nrows,
+            ncols=t.ncols,
+        )
+        return SpTuples.concat([base, extra])
+
+    return _tile_map_jit(mat, f, indexed=True)
+
+
+@partial(jax.jit, static_argnames=("k",))
+def _kselect_jit(mat: SpParMat, k, kvec: DistVec | None) -> DistVec:
+    lc = mat.local_cols
+    dtype = mat.dtype
+
+    def body(rows, cols, vals, nnz, *maybe_k):
+        t = mat.local_tile(rows, cols, vals, nnz)
+        keys = _monotone_key_u32(t.vals)
+        valid = t.valid_mask()
+        ids = jnp.where(valid, t.cols, lc)
+        idx = jnp.minimum(ids, lc - 1)
+        kcol = (
+            maybe_k[0][0].astype(jnp.int32)
+            if maybe_k
+            else jnp.full((lc,), k, jnp.int32)
+        )
+
+        def col_count(ge_mask):
+            local = jax.ops.segment_sum(
+                ge_mask.astype(jnp.int32), ids, num_segments=lc
+            )
+            return lax.psum(local, ROW_AXIS)
+
+        total = col_count(valid)
+        thresh = jnp.zeros((lc,), jnp.uint32)
+        for b in range(31, -1, -1):
+            cand = thresh | jnp.uint32(1 << b)
+            cnt = col_count(valid & (keys >= cand[idx]))
+            thresh = jnp.where(cnt >= kcol, cand, thresh)
+        out = _u32_key_to_val(thresh, dtype)
+        out = jnp.where(total < kcol, _minval(dtype), out)
+        return out[None]
+
+    args = (mat.rows, mat.cols, mat.vals, mat.nnz) + (
+        (kvec.blocks,) if kvec is not None else ()
+    )
+    vspecs = (P(COL_AXIS),) if kvec is not None else ()
+    blocks = jax.shard_map(
+        body,
+        mesh=mat.grid.mesh,
+        in_specs=(TILE_SPEC,) * 4 + vspecs,
+        out_specs=P(COL_AXIS),
+        check_vma=False,
+    )(*args)
+    return DistVec(blocks=blocks, length=mat.ncols, align="col", grid=mat.grid)
+
+
+@partial(jax.jit, static_argnames=("keep",))
+def _prune_column_jit(mat: SpParMat, vec: DistVec, keep) -> SpParMat:
+    def body(rows, cols, vals, nnz, vblk):
+        t = mat.local_tile(rows, cols, vals, nnz)
+        v = vblk[0]
+        idx = jnp.minimum(t.cols, v.shape[0] - 1)
+        keepmask = t.valid_mask() & keep(t.vals, v[idx])
+        return SpParMat._pack_tile(t._select(keepmask))
+
+    r, c, v, n = jax.shard_map(
+        body,
+        mesh=mat.grid.mesh,
+        in_specs=(TILE_SPEC,) * 4 + (P(COL_AXIS),),
+        out_specs=(TILE_SPEC,) * 4,
+    )(mat.rows, mat.cols, mat.vals, mat.nnz, vec.blocks)
+    return dataclasses.replace(mat, rows=r, cols=c, vals=v, nnz=n)
+
+
+@partial(jax.jit, static_argnames=("nsplits",))
+def _col_split_jit(mat: SpParMat, nsplits: int):
+    lc = mat.local_cols
+    lw = lc // nsplits
+    outs = []
+    for s in range(nsplits):
+        lo = s * lw
+
+        def f(t: SpTuples, lo=lo):
+            keep = t.valid_mask() & (t.cols >= lo) & (t.cols < lo + lw)
+            sel = t._select(keep)
+            cols = jnp.where(sel.valid_mask(), sel.cols - lo, lw)
+            return SpTuples(
+                rows=sel.rows, cols=cols, vals=sel.vals, nnz=sel.nnz,
+                nrows=t.nrows, ncols=lw,
             )
 
-        r, c, v, n = jax.shard_map(
-            body,
-            mesh=self.grid.mesh,
-            in_specs=(TILE_SPEC,) * 4 + (vspec,),
-            out_specs=(TILE_SPEC,) * 4,
-        )(self.rows, self.cols, self.vals, self.nnz, vec.blocks)
-        return dataclasses.replace(self, rows=r, cols=c, vals=v, nnz=n)
+        outs.append(
+            _tile_map_jit(mat, f, out_meta=(mat.nrows, lw * mat.grid.pc))
+        )
+    return tuple(outs)
+
+
+@jax.jit
+def _col_concat_jit(mats: tuple) -> SpParMat:
+    g = mats[0].grid
+    lcs = [m.local_cols for m in mats]
+    lc_out = sum(lcs)
+    ncols = sum(m.ncols for m in mats)
+    pieces, off = [], 0
+    for m, w in zip(mats, lcs):
+
+        def f(t: SpTuples, off=off):
+            cols = jnp.where(t.valid_mask(), t.cols + off, lc_out)
+            return dataclasses.replace(t, cols=cols)
+
+        pieces.append(_tile_map_jit(m, f))
+        off += w
+    return SpParMat(
+        rows=jnp.concatenate([p.rows for p in pieces], axis=2),
+        cols=jnp.concatenate([p.cols for p in pieces], axis=2),
+        vals=jnp.concatenate([p.vals for p in pieces], axis=2),
+        nnz=sum(p.nnz for p in pieces[1:]) + pieces[0].nnz,
+        nrows=mats[0].nrows,
+        ncols=ncols,
+        grid=g,
+    )
+
+
+@partial(jax.jit, static_argnames=("sr", "axis", "map_fn"))
+def _reduce_jit(mat: SpParMat, sr: Semiring, axis: str, map_fn) -> DistVec:
+    lr, lc = mat.local_rows, mat.local_cols
+    out_len = mat.ncols if axis == "rows" else mat.nrows
+    align = "col" if axis == "rows" else "row"
+    comm_axis = ROW_AXIS if axis == "rows" else COL_AXIS
+    seg_n = lc if axis == "rows" else lr
+
+    def body(rows, cols, vals, nnz):
+        t = mat.local_tile(rows, cols, vals, nnz)
+        v = map_fn(t.vals) if map_fn is not None else t.vals
+        ids = t.cols if axis == "rows" else t.rows
+        local = segment_reduce(sr, v, ids, seg_n)
+        return axis_reduce(sr, local, comm_axis)[None]
+
+    out_specs = P(COL_AXIS) if axis == "rows" else P(ROW_AXIS)
+    blocks = jax.shard_map(
+        body,
+        mesh=mat.grid.mesh,
+        in_specs=(TILE_SPEC,) * 4,
+        out_specs=out_specs,
+    )(mat.rows, mat.cols, mat.vals, mat.nnz)
+    return DistVec(blocks=blocks, length=out_len, align=align, grid=mat.grid)
+
+
+@jax.jit
+def _transpose_jit(mat: SpParMat) -> SpParMat:
+    grid = mat.grid
+    perm = grid.transpose_perm()
+
+    def body(rows, cols, vals, nnz):
+        t = mat.local_tile(rows, cols, vals, nnz).transpose()
+        packed = SpParMat._pack_tile(t)
+        return tuple(
+            lax.ppermute(x, (ROW_AXIS, COL_AXIS), perm) for x in packed
+        )
+
+    r, c, v, n = jax.shard_map(
+        body,
+        mesh=grid.mesh,
+        in_specs=(TILE_SPEC,) * 4,
+        out_specs=(TILE_SPEC,) * 4,
+    )(mat.rows, mat.cols, mat.vals, mat.nnz)
+    return SpParMat(
+        rows=r, cols=c, vals=v, nnz=n,
+        nrows=mat.ncols, ncols=mat.nrows, grid=grid,
+    )
+
+
+@partial(jax.jit, static_argnames=("fn", "axis"))
+def _dim_apply_jit(mat: SpParMat, vec: DistVec, fn, axis: str) -> SpParMat:
+    vspec = P(COL_AXIS) if axis == "cols" else P(ROW_AXIS)
+
+    def body(rows, cols, vals, nnz, vblk):
+        t = mat.local_tile(rows, cols, vals, nnz)
+        v = vblk[0]
+        vpad = jnp.concatenate([v, jnp.zeros((1,), v.dtype)])
+        idx = t.cols if axis == "cols" else t.rows
+        idx = jnp.minimum(idx, v.shape[0])
+        new_vals = jnp.where(t.valid_mask(), fn(t.vals, vpad[idx]), t.vals)
+        return SpParMat._pack_tile(dataclasses.replace(t, vals=new_vals))
+
+    r, c, v, n = jax.shard_map(
+        body,
+        mesh=mat.grid.mesh,
+        in_specs=(TILE_SPEC,) * 4 + (vspec,),
+        out_specs=(TILE_SPEC,) * 4,
+    )(mat.rows, mat.cols, mat.vals, mat.nnz, vec.blocks)
+    return dataclasses.replace(mat, rows=r, cols=c, vals=v, nnz=n)
