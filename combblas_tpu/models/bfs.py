@@ -28,7 +28,7 @@ import jax.numpy as jnp
 
 from ..semiring import PLUS_TIMES, SELECT2ND_MAX
 from ..parallel.spmat import SpParMat, ones_i32
-from ..parallel.spmv import dist_spmv_masked
+from ..parallel.spmv import dist_spmspv_masked, dist_spmv_masked
 from ..parallel.vec import DistVec
 
 
@@ -89,6 +89,140 @@ def bfs(A: SpParMat, source, max_iters: int | None = None):
         cond, step, (parents0, levels0, x0, jnp.int32(0), jnp.bool_(True))
     )
     return mk_row(parents), mk_row(levels), niter
+
+
+@partial(jax.jit, static_argnames=("frontier_capacity", "exp_capacity"))
+def _diropt_topdown_step(
+    A, parents, levels, x, row_gids, level, frontier_capacity, exp_capacity
+):
+    """One sparse-frontier (top-down) level. x is the col-aligned dense
+    candidate vector (-1 = inactive)."""
+    grid = A.grid
+    n = A.nrows
+    unvisited = DistVec(
+        blocks=parents < 0, length=n, align="row", grid=grid
+    )
+    xv = DistVec(blocks=x, length=A.ncols, align="col", grid=grid)
+    xact = DistVec(blocks=x >= 0, length=A.ncols, align="col", grid=grid)
+    y = dist_spmspv_masked(
+        SELECT2ND_MAX, A, xv, xact, unvisited,
+        frontier_capacity=frontier_capacity, exp_capacity=exp_capacity,
+    )
+    return _diropt_update(A, parents, levels, y, row_gids, level)
+
+
+@jax.jit
+def _diropt_bottomup_step(A, parents, levels, x, row_gids, level):
+    """One dense (bottom-up regime) level: every unvisited vertex probes all
+    its neighbors in one masked SpMV — the dense formulation that plays the
+    role of the reference's BottomUpStep carousel (``BFSFriends.h:457-560``;
+    the ring rotation is XLA's own ICI all-reduce lowering of the fold)."""
+    grid = A.grid
+    n = A.nrows
+    unvisited = DistVec(blocks=parents < 0, length=n, align="row", grid=grid)
+    xv = DistVec(blocks=x, length=A.ncols, align="col", grid=grid)
+    y = dist_spmv_masked(SELECT2ND_MAX, A, xv, unvisited)
+    return _diropt_update(A, parents, levels, y, row_gids, level)
+
+
+def _diropt_update(A, parents, levels, y, row_gids, level):
+    new = (y.blocks >= 0) & (parents < 0) & (row_gids >= 0)
+    parents = jnp.where(new, y.blocks, parents)
+    levels = jnp.where(new, level + 1, levels)
+    frontier_row = DistVec(
+        blocks=jnp.where(new, row_gids, -1), length=A.nrows, align="row",
+        grid=A.grid,
+    )
+    x_next = frontier_row.realign("col").blocks
+    nnew = jnp.sum(new).astype(jnp.int32)
+    return parents, levels, x_next, nnew
+
+
+@jax.jit
+def _frontier_stats(x, deg_blocks):
+    """(frontier vertex count, frontier out-edge count) from the col-aligned
+    candidate vector.
+
+    The edge count accumulates in float32: int32 would wrap for hub-heavy
+    frontiers at Graph500 scale and silently corrupt the regime switch. The
+    caller compensates for float32 rounding with a 1% comparison margin.
+    """
+    act = x >= 0
+    cnt = jnp.sum(act)
+    edges = jnp.sum(jnp.where(act, deg_blocks, 0).astype(jnp.float32))
+    return cnt, edges
+
+
+def bfs_diropt(
+    A: SpParMat,
+    source,
+    *,
+    frontier_capacity: int | None = None,
+    exp_capacity: int | None = None,
+    max_iters: int | None = None,
+):
+    """Direction-optimizing BFS (≈ Applications/DirOptBFS.cpp, Beamer).
+
+    Host-level per-level switch (the reference also decides per iteration):
+    run the sparse-frontier top-down kernel while the frontier fits the
+    static budgets — per-tile frontier slots (``frontier_capacity``) and
+    walked edges (``exp_capacity``) — and the dense bottom-up formulation
+    otherwise. Both regimes compile once and are reused across levels and
+    roots. On TPU the bottom-up "carousel" ring schedule is XLA's own
+    all-reduce lowering; what survives of direction optimization is the work
+    bound: top-down costs O(budgets), bottom-up costs O(tile nnz).
+
+    Returns (parents, levels, num_iters) like ``bfs``.
+    """
+    grid = A.grid
+    n = A.nrows
+    pr_, lr = grid.pr, grid.local_rows(n)
+    pc_, lc = grid.pc, grid.local_cols(A.ncols)
+    cap = A.capacity
+    if frontier_capacity is None:
+        frontier_capacity = max(64, lc // 8 + 1)
+    frontier_capacity = min(frontier_capacity, lc)
+    if exp_capacity is None:
+        exp_capacity = max(256, cap // 8 + 1)
+    exp_capacity = min(exp_capacity, cap)
+    iters = max_iters if max_iters is not None else n
+
+    row_gids = _global_ids(grid, pr_, lr, n, "row")
+    col_gids = _global_ids(grid, pc_, lc, A.ncols, "col")
+    parents = jnp.where(row_gids == source, jnp.int32(source), -1)
+    levels = jnp.where(row_gids == source, 0, -1).astype(jnp.int32)
+    x = jnp.where(col_gids == source, jnp.int32(source), -1)
+
+    # Out-degree per column (structural), for the edge-budget check.
+    deg = A.reduce(PLUS_TIMES, "rows", map_fn=ones_i32).blocks
+
+    level = jnp.int32(0)
+    it = 0
+    for it in range(1, iters + 1):
+        cnt, edges = _frontier_stats(x, deg)
+        # Host switch: budgets are per-tile worst case; the global counts
+        # bound every tile's share, so fitting globally fits locally. The 1%
+        # margin covers float32 summation error in the edge count — walking
+        # even one edge past exp_capacity would silently drop frontier edges.
+        use_topdown = (
+            int(cnt) <= frontier_capacity
+            and float(edges) <= 0.99 * exp_capacity
+        )
+        if use_topdown:
+            parents, levels, x, nnew = _diropt_topdown_step(
+                A, parents, levels, x, row_gids, level,
+                frontier_capacity, exp_capacity,
+            )
+        else:
+            parents, levels, x, nnew = _diropt_bottomup_step(
+                A, parents, levels, x, row_gids, level
+            )
+        level = level + 1
+        if int(nnew) == 0:
+            break
+
+    mk = lambda b: DistVec(blocks=b, length=n, align="row", grid=grid)
+    return mk(parents), mk(levels), it
 
 
 def traversed_edges(A: SpParMat, parents: DistVec) -> jax.Array:

@@ -159,6 +159,9 @@ def mcl(
 ) -> tuple[DistVec, int, float]:
     """Markov clustering. Returns (cluster labels, iterations, final chaos).
 
+    ``phases > 1`` requires n % (grid.pc * phases) == 0 (the local column
+    split); otherwise expansion falls back to unphased with a warning.
+
     Reference driver: ``HipMCL`` (MCL.cpp:515-660); defaults mirror
     ``InitParam`` (MCL.cpp:144-150: prunelimit 1e-4, select 1100, recover
     1400/0.9). Per reference loop order, chaos is measured on the expanded

@@ -54,6 +54,51 @@ def spmv_masked(sr: Semiring, a: SpTuples, x: Array, row_active: Array) -> Array
     return jnp.where(row_active, y, sr.zero(y.dtype))
 
 
+def _expand_products(
+    sr: Semiring, a_csc: CSC, x_ind: Array, x_val: Array, exp_capacity: int
+) -> tuple[Array, Array]:
+    """Walk active columns, flattening (entry, active col) pairs into
+    ``exp_capacity`` static slots → (row ids, semiring products).
+
+    Precondition: distinct valid x_ind and total active-column length
+    <= exp_capacity (overflowing pairs are silently dropped — callers bound
+    the frontier edge count before choosing this kernel).
+    """
+    x_ind = jnp.where(x_ind < a_csc.ncols, x_ind, a_csc.ncols)
+    lens_pad = jnp.concatenate([a_csc.col_lens(), jnp.zeros((1,), jnp.int32)])
+    starts_pad = jnp.concatenate(
+        [a_csc.indptr[:-1], jnp.zeros((1,), jnp.int32)]
+    )
+    xlens = lens_pad[jnp.minimum(x_ind, a_csc.ncols)]
+    owner, offset, valid, _total = expand_ranges(xlens, exp_capacity)
+    src_col_start = starts_pad[jnp.minimum(x_ind[owner], a_csc.ncols)]
+    slot = src_col_start + offset
+    row = jnp.where(valid, a_csc.indices[slot], a_csc.nrows)
+    prod = sr.mul(a_csc.vals[slot], x_val[owner])
+    return row, prod
+
+
+def spmspv_dense_out(
+    sr: Semiring,
+    a_csc: CSC,
+    x_ind: Array,
+    x_val: Array,
+    *,
+    exp_capacity: int,
+) -> Array:
+    """Sparse-x, DENSE-y semiring SpMSpV: ``y[i] = ⊕ a[i,j] ⊗ x[j]`` over
+    active columns j; untouched rows get ``sr.zero``.
+
+    The top-down BFS kernel: work scales with ``exp_capacity`` (the frontier
+    edge budget), not the tile nnz — the static-shape counterpart of the
+    reference's "touch only active columns" SpMSpV advantage
+    (``SpImpl.cpp:390-600``). The distributed driver checks the global
+    frontier edge count against the budget before selecting this kernel.
+    """
+    row, prod = _expand_products(sr, a_csc, x_ind, x_val, exp_capacity)
+    return segment_reduce(sr, prod, row, a_csc.nrows)
+
+
 def spmspv(
     sr: Semiring,
     a_csc: CSC,
@@ -82,19 +127,9 @@ def spmspv(
     slots) → semiring combine by destination row → compaction.
     """
     del x_nnz  # validity comes from the sentinel ids
-    x_ind = jnp.where(x_ind < a_csc.ncols, x_ind, a_csc.ncols)
-    # Column lengths for each active x entry (0 for padding).
-    lens_pad = jnp.concatenate([a_csc.col_lens(), jnp.zeros((1,), jnp.int32)])
-    starts_pad = jnp.concatenate([a_csc.indptr[:-1], jnp.zeros((1,), jnp.int32)])
-    xlens = lens_pad[jnp.minimum(x_ind, a_csc.ncols)]
     # Expansion capacity: with distinct active columns (precondition above),
     # every valid A entry is touched at most once → tile capacity bounds it.
-    exp_cap = a_csc.capacity
-    owner, offset, valid, _total = expand_ranges(xlens, exp_cap)
-    src_col_start = starts_pad[jnp.minimum(x_ind[owner], a_csc.ncols)]
-    slot = src_col_start + offset
-    row = jnp.where(valid, a_csc.indices[slot], a_csc.nrows)
-    prod = sr.mul(a_csc.vals[slot], x_val[owner])
+    row, prod = _expand_products(sr, a_csc, x_ind, x_val, a_csc.capacity)
     y_dense = segment_reduce(sr, prod, row, a_csc.nrows)
     # Compact nonzero (≠ semiring zero) entries.
     zero = sr.zero(y_dense.dtype)

@@ -1,0 +1,171 @@
+"""DenseParMat — distributed dense 2D matrix (≈ DenseParMat<IT,NT>).
+
+The reference's minimal dense companion to SpParMat (``DenseParMat.h:128``,
+used by betweenness centrality to accumulate per-vertex path counts /
+dependencies). Tiles are stored as one ``[pr, pc, lr, lc]`` array sharded so
+device (i,j) holds dense tile (i,j) — matrix-conformant with SpParMat's
+block distribution, so sparse↔dense elementwise ops need no communication.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..semiring import Semiring
+from .collectives import axis_reduce
+from .grid import COL_AXIS, ROW_AXIS, Grid
+from .spmat import TILE_SPEC, SpParMat
+from .vec import DistVec
+
+Array = jax.Array
+
+
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=["blocks"],
+    meta_fields=["nrows", "ncols", "grid"],
+)
+@dataclasses.dataclass(frozen=True)
+class DenseParMat:
+    """blocks: NT[pr, pc, lr, lc]; padding cells (beyond nrows/ncols) must
+    stay inert for the ops applied (constructors zero-fill)."""
+
+    blocks: Array
+    nrows: int
+    ncols: int
+    grid: Grid
+
+    @property
+    def local_rows(self) -> int:
+        return self.blocks.shape[2]
+
+    @property
+    def local_cols(self) -> int:
+        return self.blocks.shape[3]
+
+    @property
+    def dtype(self):
+        return self.blocks.dtype
+
+    # --- construction -----------------------------------------------------
+
+    @staticmethod
+    def zeros(grid: Grid, nrows: int, ncols: int, dtype=jnp.float32):
+        lr, lc = grid.local_rows(nrows), grid.local_cols(ncols)
+        blocks = jax.device_put(
+            jnp.zeros((grid.pr, grid.pc, lr, lc), dtype), grid.tile_sharding()
+        )
+        return DenseParMat(blocks=blocks, nrows=nrows, ncols=ncols, grid=grid)
+
+    @staticmethod
+    def from_global(grid: Grid, dense) -> "DenseParMat":
+        dense = np.asarray(dense)
+        m, n = dense.shape
+        lr, lc = grid.local_rows(m), grid.local_cols(n)
+        padded = np.zeros((grid.pr * lr, grid.pc * lc), dense.dtype)
+        padded[:m, :n] = dense
+        blocks = (
+            padded.reshape(grid.pr, lr, grid.pc, lc).transpose(0, 2, 1, 3)
+        )
+        return DenseParMat(
+            blocks=jax.device_put(jnp.asarray(blocks), grid.tile_sharding()),
+            nrows=m, ncols=n, grid=grid,
+        )
+
+    def to_global(self) -> np.ndarray:
+        b = np.asarray(self.blocks)
+        full = b.transpose(0, 2, 1, 3).reshape(
+            self.grid.pr * self.local_rows, self.grid.pc * self.local_cols
+        )
+        return full[: self.nrows, : self.ncols]
+
+    # --- elementwise ------------------------------------------------------
+
+    def apply(self, fn) -> "DenseParMat":
+        return dataclasses.replace(self, blocks=fn(self.blocks))
+
+    def ewise(self, other: "DenseParMat", fn) -> "DenseParMat":
+        assert self.grid == other.grid
+        return dataclasses.replace(self, blocks=fn(self.blocks, other.blocks))
+
+    # --- sparse interplay -------------------------------------------------
+
+    def add_spmat(self, S: SpParMat, combine=None) -> "DenseParMat":
+        """self[i,j] ← combine(self[i,j], S[i,j]) on S's nonzero pattern
+        (default: +).
+
+        Reference: ``DenseParMat::operator+=(SpParMat)`` — the BC
+        accumulation step (BetwCent.cpp:207). No communication: tiles align.
+        """
+        assert self.grid == S.grid
+        assert (self.nrows, self.ncols) == (S.nrows, S.ncols)
+        return _add_spmat_jit(self, S, combine)
+
+    # --- reductions -------------------------------------------------------
+
+    def reduce(self, sr: Semiring, axis: str, map_fn=None) -> DistVec:
+        """Fold along ``axis`` like ``SpParMat.reduce`` (dense analog):
+        axis="rows" → col-aligned vec[ncols]; axis="cols" → row-aligned
+        vec[nrows]."""
+        return _dense_reduce_jit(self, sr, axis, map_fn)
+
+
+@partial(jax.jit, static_argnames=("combine",))
+def _add_spmat_jit(D: DenseParMat, S: SpParMat, combine) -> DenseParMat:
+    def body(blk, rows, cols, vals, nnz):
+        t = S.local_tile(rows, cols, vals, nnz)
+        b = blk[0, 0]
+        if combine is None:
+            out = b.at[t.rows, t.cols].add(
+                jnp.where(t.valid_mask(), t.vals, 0).astype(b.dtype),
+                mode="drop",
+            )
+        else:
+            cur = b[jnp.minimum(t.rows, b.shape[0] - 1),
+                    jnp.minimum(t.cols, b.shape[1] - 1)]
+            new = combine(cur, t.vals.astype(b.dtype))
+            out = b.at[t.rows, t.cols].set(
+                jnp.where(t.valid_mask(), new, cur), mode="drop"
+            )
+        return out[None, None]
+
+    blocks = jax.shard_map(
+        body,
+        mesh=D.grid.mesh,
+        in_specs=(TILE_SPEC,) * 5,
+        out_specs=TILE_SPEC,
+    )(D.blocks, S.rows, S.cols, S.vals, S.nnz)
+    return dataclasses.replace(D, blocks=blocks)
+
+
+@partial(jax.jit, static_argnames=("sr", "axis", "map_fn"))
+def _dense_reduce_jit(
+    D: DenseParMat, sr: Semiring, axis: str, map_fn
+) -> DistVec:
+    out_len = D.ncols if axis == "rows" else D.nrows
+    align = "col" if axis == "rows" else "row"
+    comm_axis = ROW_AXIS if axis == "rows" else COL_AXIS
+    fold_dim = 0 if axis == "rows" else 1
+
+    def body(blk):
+        b = blk[0, 0]
+        v = map_fn(b) if map_fn is not None else b
+        zero = sr.zero(v.dtype)
+        local = lax.reduce(v, zero, sr.add, (fold_dim,))
+        return axis_reduce(sr, local, comm_axis)[None]
+
+    out_specs = P(COL_AXIS) if axis == "rows" else P(ROW_AXIS)
+    blocks = jax.shard_map(
+        body,
+        mesh=D.grid.mesh,
+        in_specs=(TILE_SPEC,),
+        out_specs=out_specs,
+    )(D.blocks)
+    return DistVec(blocks=blocks, length=out_len, align=align, grid=D.grid)

@@ -244,12 +244,27 @@ def mem_efficient_spgemm(
     ``EstPerProcessNnzSUMMA``; here the symbolic pass inside ``spgemm`` sizes
     each phase exactly, so callers choose ``phases`` directly.
     """
+    lc = B.local_cols
+    splittable = B.ncols == lc * B.grid.pc and lc % max(phases, 1) == 0
+    if phases > 1 and not splittable:
+        import warnings
+
+        warnings.warn(
+            f"mem_efficient_spgemm: ncols={B.ncols} not splittable into "
+            f"{phases} phases on a {B.grid.pr}x{B.grid.pc} grid "
+            "(needs ncols % (pc * phases) == 0); running unphased",
+            stacklevel=2,
+        )
+        phases = 1
     if phases <= 1:
         C = spgemm(sr, A, B, slack)
         return prune_fn(C) if prune_fn is not None else C
     outs = []
     for Bs in B.col_split(phases):
-        C = spgemm(sr, A, Bs, slack)
+        # A phase holds ~1/phases of the nnz but inherits B's full slot
+        # capacity from col_split; truncate so the per-phase SUMMA gathers
+        # phase-sized arrays (the point of phasing is peak-memory reduction).
+        C = spgemm(sr, A, Bs.shrink_to_fit(), slack)
         if prune_fn is not None:
             C = prune_fn(C)
         outs.append(C)

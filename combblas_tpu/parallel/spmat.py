@@ -379,6 +379,41 @@ class SpParMat:
         """
         return _prune_column_jit(self, vec.realign("col"), keep)
 
+    def with_capacity(self, capacity: int) -> "SpParMat":
+        """Grow or shrink every tile's slot capacity.
+
+        Shrinking requires compacted tiles with max nnz <= capacity (checked
+        host-side by ``shrink_to_fit``; under jit the caller guarantees it).
+        """
+        if capacity == self.capacity:
+            return self
+        return _with_capacity_jit(self, capacity)
+
+    def shrink_to_fit(self, pow2: bool = True) -> "SpParMat":
+        """Host helper: truncate capacity to the max tile nnz (optionally
+        rounded up to a power of two for compile-cache stability).
+
+        Keeps phased/iterative pipelines from dragging a large parent
+        capacity through every collective (e.g. the col_split pieces of
+        MemEfficientSpGEMM would otherwise all-gather full-size arrays).
+        """
+        need = max(int(np.max(np.asarray(self.nnz))), 1)
+        if pow2:
+            need = 1 << (need - 1).bit_length()
+        return self.with_capacity(min(need, self.capacity))
+
+    def prune_rowcol(self, rvec: DistVec, cvec: DistVec, keep) -> "SpParMat":
+        """Keep entry (i,j) iff ``keep(val, rvec[i], cvec[j])``.
+
+        The two-sided companion of ``prune_column`` — the zero-out step of
+        SpAsgn (reference ``SpParMat::SpAsgn``, SpParMat.cpp:2427, expressed
+        there as A - S*A*T with selection matrices; a direct masked prune is
+        cheaper than two SpGEMMs).
+        """
+        return _prune_rowcol_jit(
+            self, rvec.realign("row"), cvec.realign("col"), keep
+        )
+
     # --- local column split / concat (phased execution) --------------------
 
     def col_split(self, nsplits: int) -> list["SpParMat"]:
@@ -695,6 +730,42 @@ def _prune_column_jit(mat: SpParMat, vec: DistVec, keep) -> SpParMat:
         in_specs=(TILE_SPEC,) * 4 + (P(COL_AXIS),),
         out_specs=(TILE_SPEC,) * 4,
     )(mat.rows, mat.cols, mat.vals, mat.nnz, vec.blocks)
+    return dataclasses.replace(mat, rows=r, cols=c, vals=v, nnz=n)
+
+
+@partial(jax.jit, static_argnames=("capacity",))
+def _with_capacity_jit(mat: SpParMat, capacity: int) -> SpParMat:
+    return _tile_map_jit(mat, _with_capacity_fn(capacity))
+
+
+@lru_cache(maxsize=None)
+def _with_capacity_fn(capacity: int):
+    def f(t: SpTuples) -> SpTuples:
+        return t.with_capacity(capacity)
+
+    return f
+
+
+@partial(jax.jit, static_argnames=("keep",))
+def _prune_rowcol_jit(
+    mat: SpParMat, rvec: DistVec, cvec: DistVec, keep
+) -> SpParMat:
+    def body(rows, cols, vals, nnz, rblk, cblk):
+        t = mat.local_tile(rows, cols, vals, nnz)
+        rv, cv = rblk[0], cblk[0]
+        rpad = jnp.concatenate([rv, jnp.zeros((1,), rv.dtype)])
+        cpad = jnp.concatenate([cv, jnp.zeros((1,), cv.dtype)])
+        ri = jnp.minimum(t.rows, rv.shape[0])
+        ci = jnp.minimum(t.cols, cv.shape[0])
+        keepmask = t.valid_mask() & keep(t.vals, rpad[ri], cpad[ci])
+        return SpParMat._pack_tile(t._select(keepmask))
+
+    r, c, v, n = jax.shard_map(
+        body,
+        mesh=mat.grid.mesh,
+        in_specs=(TILE_SPEC,) * 4 + (P(ROW_AXIS), P(COL_AXIS)),
+        out_specs=(TILE_SPEC,) * 4,
+    )(mat.rows, mat.cols, mat.vals, mat.nnz, rvec.blocks, cvec.blocks)
     return dataclasses.replace(mat, rows=r, cols=c, vals=v, nnz=n)
 
 

@@ -19,12 +19,15 @@ the same schedule with the local kernel swapped to ``ops.spmv.spmspv``.
 
 from __future__ import annotations
 
+from functools import partial
+
 import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ..ops.compressed import CSC
 from ..ops.spmv import spmspv as local_spmspv
+from ..ops.spmv import spmspv_dense_out
 from ..ops.spmv import spmv as local_spmv
 from ..semiring import Semiring
 from .collectives import axis_reduce
@@ -82,4 +85,73 @@ def dist_spmv_masked(
         in_specs=(TILE_SPEC,) * 4 + (P(COL_AXIS), P(ROW_AXIS)),
         out_specs=P(ROW_AXIS),
     )(A.rows, A.cols, A.vals, A.nnz, x.blocks, row_active.blocks)
+    return DistVec(blocks=blocks, length=A.nrows, align="row", grid=A.grid)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("sr", "frontier_capacity", "exp_capacity"),
+)
+def dist_spmspv_masked(
+    sr: Semiring,
+    A: SpParMat,
+    x: DistVec,
+    x_active: DistVec,
+    row_active: DistVec,
+    *,
+    frontier_capacity: int,
+    exp_capacity: int,
+) -> DistVec:
+    """Masked SpMV where only columns with ``x_active`` participate, and the
+    local kernel walks ONLY those columns.
+
+    The distributed top-down BFS kernel (≈ ``BFSFriends.h:328-395`` over
+    ``SpImpl::SpMXSpV``): per tile, the dense col-aligned candidate vector is
+    compacted to at most ``frontier_capacity`` active local columns, and the
+    column walk expands into ``exp_capacity`` static slots. The caller MUST
+    guarantee (host-side, from the global frontier size / frontier edge
+    count) that per-tile actives fit ``frontier_capacity`` and per-tile
+    walked entries fit ``exp_capacity`` — the direction-optimizing driver
+    falls back to ``dist_spmv_masked`` otherwise. Work per step scales with
+    the static budgets, not the tile nnz: that is the whole point of the
+    top-down regime.
+    """
+    assert x.length == A.ncols
+    x = x.realign("col")
+    x_active = x_active.realign("col")
+    row_active = row_active.realign("row")
+    lc = A.local_cols
+
+    def body(rows, cols, vals, nnz, xblk, xactblk, actblk):
+        t = A.local_tile(rows, cols, vals, nnz)
+        xv, xa = xblk[0], xactblk[0]
+        # Compact active local columns into the static frontier buffer.
+        pos = jnp.cumsum(xa.astype(jnp.int32)) - 1
+        scatter = jnp.where(xa, pos, frontier_capacity)
+        x_ind = (
+            jnp.full((frontier_capacity,), lc, jnp.int32)
+            .at[scatter]
+            .set(jnp.arange(lc, dtype=jnp.int32), mode="drop")
+        )
+        x_val = (
+            jnp.full((frontier_capacity,), sr.zero(xv.dtype), xv.dtype)
+            .at[scatter]
+            .set(xv, mode="drop")
+        )
+        csc = CSC.from_tuples(t)
+        y_loc = spmspv_dense_out(
+            sr, csc, x_ind, x_val, exp_capacity=exp_capacity
+        )
+        y_loc = jnp.where(actblk[0], y_loc, sr.zero(y_loc.dtype))
+        return axis_reduce(sr, y_loc, COL_AXIS)[None]
+
+    blocks = jax.shard_map(
+        body,
+        mesh=A.grid.mesh,
+        in_specs=(TILE_SPEC,) * 4 + (P(COL_AXIS), P(COL_AXIS), P(ROW_AXIS)),
+        out_specs=P(ROW_AXIS),
+    )(
+        A.rows, A.cols, A.vals, A.nnz,
+        x.blocks, x_active.blocks, row_active.blocks,
+    )
     return DistVec(blocks=blocks, length=A.nrows, align="row", grid=A.grid)

@@ -214,6 +214,63 @@ class DistVec:
             self.blocks, sr.zero(self.blocks.dtype), sr.add, (0, 1)
         )
 
+    # --- FullyDistVec op pack (sort / find / permute family) ---------------
+
+    def sort(self) -> tuple["DistVec", "DistVec"]:
+        """Ascending sort. Returns (sorted values, original indices).
+
+        Reference: ``FullyDistVec::sort`` (there a psort; here XLA's native
+        sharded sort over the global view — the distributed-sorting strategy
+        of SURVEY §2.3(8) collapses into one collective sort on ICI).
+        Padding slots sort to the tail regardless of their value.
+        """
+        return _sort_jit(self)
+
+    def find_inds(self, pred) -> tuple["DistVec", Array]:
+        """Global indices i (ascending) with ``pred(self[i])``.
+
+        Reference: ``FullyDistVec::FindInds`` — there a variable-length
+        result vector; here a fixed-capacity DistVec whose first ``count``
+        slots hold the indices and whose tail holds the sentinel
+        ``self.length``. Returns (indices, count). Pass a module-level
+        ``pred`` for compile-cache hits.
+        """
+        return _find_inds_jit(self, pred)
+
+    def invert(self, active: "DistVec", out_length: int, sr: Semiring) -> "DistVec":
+        """out[self[i]] = i for active slots i; collisions resolved by
+        ``sr.add``; untouched outputs get -1.
+
+        Reference: ``FullyDistSpVec::Invert`` (FullyDistSpVec.h:89-93) — the
+        value↔index flip with duplicate resolution. ``active`` is the
+        bool mask standing in for the sparse vector's index set (our
+        masked-dense FullyDistSpVec representation).
+        """
+        return _invert_jit(self, active, out_length, sr)
+
+    def uniq(self, active: "DistVec") -> "DistVec":
+        """New active mask keeping only the first (lowest-index) occurrence
+        of each value among active slots.
+
+        Reference: ``FullyDistSpVec::Uniq``. Setminus, the other index-set
+        op of that family, is plain mask arithmetic on masked-dense vectors:
+        ``a_active & ~b_active``.
+        """
+        return _uniq_jit(self, active)
+
+    @staticmethod
+    def randperm(grid: Grid, length: int, key, align: str = "col") -> "DistVec":
+        """Uniform random permutation of [0, length).
+
+        Reference: ``FullyDistVec::RandPerm`` (FullyDistVec.cpp:783-870) —
+        there a random-destination Alltoallv + local shuffle; here one
+        sort-by-random-key over the sharded global view.  ``key`` is a JAX
+        PRNG key (the deterministic-stream analog of the reference's
+        per-rank seeds).
+        """
+        v = DistVec.iota(grid, length, jnp.int32, align=align)
+        return _randperm_jit(v, key)
+
     # --- alignment conversion (the TransposeVector analog) ----------------
 
     def realign(self, align: str) -> "DistVec":
@@ -257,3 +314,99 @@ class DistVec:
         return DistVec(
             blocks=blocks, length=self.length, align=align, grid=grid
         )
+
+
+# --- jitted impls of the op pack -------------------------------------------
+
+
+def _global_ids(vec: DistVec) -> Array:
+    pa, L = vec.blocks.shape
+    return jnp.arange(pa * L, dtype=jnp.int32)
+
+
+@jax.jit
+def _sort_jit(vec: DistVec) -> tuple[DistVec, DistVec]:
+    flat = vec.blocks.reshape(-1)
+    gids = _global_ids(vec)
+    pad = (gids >= vec.length).astype(jnp.int32)
+    _, vals, idx = lax.sort((pad, flat, gids), num_keys=2)
+    shape = vec.blocks.shape
+    return (
+        dataclasses.replace(vec, blocks=vals.reshape(shape)),
+        dataclasses.replace(vec, blocks=idx.reshape(shape)),
+    )
+
+
+@partial(jax.jit, static_argnames=("pred",))
+def _find_inds_jit(vec: DistVec, pred) -> tuple[DistVec, Array]:
+    pa, L = vec.blocks.shape
+    flat = vec.blocks.reshape(-1)
+    gids = _global_ids(vec)
+    mask = pred(flat) & (gids < vec.length)
+    pos = jnp.cumsum(mask.astype(jnp.int32)) - 1
+    out = jnp.full((pa * L,), vec.length, jnp.int32)
+    out = out.at[jnp.where(mask, pos, pa * L)].set(gids, mode="drop")
+    count = jnp.sum(mask).astype(jnp.int32)
+    return (
+        DistVec(
+            blocks=out.reshape(pa, L), length=vec.length, align=vec.align,
+            grid=vec.grid,
+        ),
+        count,
+    )
+
+
+@partial(jax.jit, static_argnames=("out_length", "sr"))
+def _invert_jit(
+    vec: DistVec, active: DistVec, out_length: int, sr: Semiring
+) -> DistVec:
+    pa = vec.grid.pr if vec.align == "row" else vec.grid.pc
+    L = -(-out_length // pa)
+    flat = vec.blocks.reshape(-1).astype(jnp.int32)
+    gids = _global_ids(vec)
+    ok = active.blocks.reshape(-1) & (gids < vec.length)
+    ids = jnp.where(ok & (flat >= 0) & (flat < out_length), flat, pa * L)
+    contrib = segment_reduce(sr, gids, ids, pa * L)
+    touched = jax.ops.segment_sum(
+        ok.astype(jnp.int32), ids, num_segments=pa * L
+    )
+    out = jnp.where(touched > 0, contrib, -1)
+    return DistVec(
+        blocks=out.reshape(pa, L), length=out_length, align=vec.align,
+        grid=vec.grid,
+    )
+
+
+@jax.jit
+def _uniq_jit(vec: DistVec, active: DistVec) -> DistVec:
+    pa, L = vec.blocks.shape
+    flat = vec.blocks.reshape(-1)
+    gids = _global_ids(vec)
+    ok = active.blocks.reshape(-1) & (gids < vec.length)
+    # Sort (inactive-last, value, gid); firsts of each active value run win.
+    inact = (~ok).astype(jnp.int32)
+    _, vals, idx = lax.sort((inact, flat, gids), num_keys=3)
+    first = jnp.concatenate(
+        [jnp.ones((1,), bool), vals[1:] != vals[:-1]]
+    )
+    n_active = jnp.sum(ok)
+    keep_sorted = first & (jnp.arange(pa * L) < n_active)
+    keep = jnp.zeros((pa * L,), bool).at[idx].set(keep_sorted)
+    return dataclasses.replace(active, blocks=keep.reshape(pa, L))
+
+
+@jax.jit
+def _randperm_jit(vec: DistVec, key) -> DistVec:
+    pa, L = vec.blocks.shape
+    gids = _global_ids(vec)
+    # 64 bits of random key per element: float32 uniforms would alias to
+    # 2^23 values and stable-sort ties toward identity order, biasing large
+    # permutations. Padding sorts last via the explicit leading key.
+    k1, k2 = jax.random.split(key)
+    r1 = jax.random.bits(k1, (pa * L,), jnp.uint32)
+    r2 = jax.random.bits(k2, (pa * L,), jnp.uint32)
+    pad = (gids >= vec.length).astype(jnp.int32)
+    _, _, _, perm = lax.sort(
+        (pad, r1, r2, vec.blocks.reshape(-1)), num_keys=3
+    )
+    return dataclasses.replace(vec, blocks=perm.reshape(pa, L))

@@ -1,0 +1,71 @@
+"""Direction-optimizing BFS vs the level-synchronous reference path.
+
+Cross-implementation equivalence, the reference's own test pattern
+(SURVEY §4.2: dobfs vs tdbfs on generated R-MAT inputs).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from combblas_tpu.models.bfs import bfs, bfs_diropt, validate_bfs_tree
+from combblas_tpu.parallel.grid import Grid
+from combblas_tpu.parallel.spmat import SpParMat
+from combblas_tpu.utils.rmat import rmat_symmetric_coo
+
+
+def _sym_random(rng, n, density):
+    d = (rng.random((n, n)) < density).astype(np.float32)
+    d = np.maximum(d, d.T)
+    np.fill_diagonal(d, 0)
+    return d
+
+
+@pytest.mark.parametrize("pr,pc", [(2, 2), (2, 4)])
+def test_diropt_matches_levelsync(rng, pr, pc):
+    grid = Grid.make(pr, pc)
+    d = _sym_random(rng, 24, 0.12)
+    A = SpParMat.from_dense(grid, d)
+    p1, l1, _ = bfs(A, 0)
+    p2, l2, _ = bfs_diropt(A, 0)
+    # Parents may differ (any valid tree); levels must match exactly.
+    np.testing.assert_array_equal(l1.to_global(), l2.to_global())
+    assert not validate_bfs_tree(d, 0, p2.to_global(), l2.to_global())
+
+
+def test_diropt_path_graph_many_levels(rng):
+    """A path forces one level per vertex and a tiny frontier throughout —
+    the pure top-down regime."""
+    grid = Grid.make(2, 2)
+    n = 16
+    d = np.zeros((n, n), np.float32)
+    for i in range(n - 1):
+        d[i, i + 1] = d[i + 1, i] = 1
+    A = SpParMat.from_dense(grid, d)
+    p, l, niter = bfs_diropt(A, 0, frontier_capacity=4, exp_capacity=16)
+    np.testing.assert_array_equal(l.to_global(), np.arange(n))
+    assert niter == n  # n-1 productive levels + 1 empty terminator
+
+
+def test_diropt_forces_bottomup(rng):
+    """Tiny budgets force the dense bottom-up path from level 1 on; results
+    must still be correct."""
+    grid = Grid.make(2, 2)
+    d = _sym_random(rng, 20, 0.3)
+    A = SpParMat.from_dense(grid, d)
+    p, l, _ = bfs_diropt(A, 3, frontier_capacity=1, exp_capacity=1)
+    p0, l0, _ = bfs(A, 3)
+    np.testing.assert_array_equal(l.to_global(), l0.to_global())
+    assert not validate_bfs_tree(d, 3, p.to_global(), l.to_global())
+
+
+def test_diropt_rmat(rng):
+    grid = Grid.make(2, 2)
+    rows, cols = rmat_symmetric_coo(jax.random.key(3), scale=7, edgefactor=6)
+    n = 1 << 7
+    A = SpParMat.from_global_coo(
+        grid, rows, cols, np.ones(len(rows), np.float32), n, n
+    )
+    dense = A.to_dense()
+    p, l, _ = bfs_diropt(A, 1)
+    assert not validate_bfs_tree(dense != 0, 1, p.to_global(), l.to_global())

@@ -1,0 +1,76 @@
+"""SpRef / SpAsgn vs numpy fancy indexing.
+
+Mirrors the reference's IndexingTest / SpAsgnTest golden pattern
+(ReleaseTests/CMakeLists.txt:41-52) with generated inputs and numpy as the
+trusted slow path.
+"""
+
+import numpy as np
+import pytest
+
+from combblas_tpu.parallel.grid import Grid
+from combblas_tpu.parallel.indexing import spasgn, subsref
+from combblas_tpu.parallel.spmat import SpParMat
+from conftest import random_dense
+
+
+@pytest.mark.parametrize("p", [1, 2])
+def test_subsref_matches_numpy(rng, p):
+    grid = Grid.make(p, p)
+    d = random_dense(rng, 20, 16, 0.3)
+    A = SpParMat.from_dense(grid, d)
+    ri = rng.integers(0, 20, size=7)
+    ci = rng.integers(0, 16, size=5)
+    B = subsref(A, ri, ci)
+    assert (B.nrows, B.ncols) == (7, 5)
+    np.testing.assert_allclose(B.to_dense(), d[np.ix_(ri, ci)], rtol=1e-6)
+
+
+def test_subsref_duplicate_indices(rng):
+    grid = Grid.make(2, 2)
+    d = random_dense(rng, 12, 12, 0.4)
+    A = SpParMat.from_dense(grid, d)
+    ri = np.array([3, 3, 0, 11])
+    ci = np.array([5, 5, 5, 1])
+    B = subsref(A, ri, ci)
+    np.testing.assert_allclose(B.to_dense(), d[np.ix_(ri, ci)], rtol=1e-6)
+
+
+def test_subsref_permutation_roundtrip(rng):
+    """A(p, p) with a permutation p — the Graph500 kernel-1 relabeling use
+    (TopDownBFS.cpp:307's A(nonisov, nonisov) SpRef)."""
+    grid = Grid.make(2, 2)
+    d = random_dense(rng, 16, 16, 0.3)
+    A = SpParMat.from_dense(grid, d)
+    p = rng.permutation(16)
+    B = subsref(A, p, p)
+    np.testing.assert_allclose(B.to_dense(), d[np.ix_(p, p)], rtol=1e-6)
+
+
+def test_spasgn_matches_numpy(rng):
+    grid = Grid.make(2, 2)
+    d = random_dense(rng, 16, 16, 0.3)
+    A = SpParMat.from_dense(grid, d)
+    ri = rng.choice(16, size=6, replace=False)
+    ci = rng.choice(16, size=4, replace=False)
+    bd = random_dense(rng, 6, 4, 0.6)
+    B = SpParMat.from_dense(grid, bd)
+    out = spasgn(A, ri, ci, B)
+    expect = d.copy()
+    expect[np.ix_(ri, ci)] = bd
+    np.testing.assert_allclose(out.to_dense(), expect, rtol=1e-6)
+
+
+def test_spasgn_preserves_outside(rng):
+    grid = Grid.make(2, 2)
+    d = random_dense(rng, 12, 12, 0.5)
+    A = SpParMat.from_dense(grid, d)
+    ri = np.array([0, 5])
+    ci = np.array([1, 7])
+    bd = np.zeros((2, 2), np.float32)  # assigning an empty block clears it
+    bd[0, 0] = 9.0
+    B = SpParMat.from_dense(grid, bd, capacity=4)
+    out = spasgn(A, ri, ci, B).to_dense()
+    expect = d.copy()
+    expect[np.ix_(ri, ci)] = bd
+    np.testing.assert_allclose(out, expect, rtol=1e-6)

@@ -1,0 +1,111 @@
+"""FullyDistVec op pack (sort/find_inds/invert/uniq/randperm) + DenseParMat."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from combblas_tpu import MAX_MIN, PLUS_TIMES, SELECT2ND_MIN
+from combblas_tpu.parallel.dense import DenseParMat
+from combblas_tpu.parallel.grid import Grid
+from combblas_tpu.parallel.spmat import SpParMat
+from combblas_tpu.parallel.vec import DistVec
+from conftest import random_dense
+
+
+def _is_pos(v):
+    return v > 0
+
+
+@pytest.mark.parametrize("align", ["row", "col"])
+def test_sort(rng, align):
+    grid = Grid.make(2, 4)
+    x = rng.integers(-50, 50, size=21).astype(np.int32)
+    v = DistVec.from_global(grid, x, align=align, fill=999)
+    sv, perm = v.sort()
+    np.testing.assert_array_equal(sv.to_global(), np.sort(x))
+    np.testing.assert_array_equal(x[perm.to_global()], np.sort(x))
+
+
+def test_find_inds(rng):
+    grid = Grid.make(2, 2)
+    x = rng.integers(-5, 5, size=19).astype(np.int32)
+    v = DistVec.from_global(grid, x, align="col", fill=0)
+    inds, count = v.find_inds(_is_pos)
+    expect = np.nonzero(x > 0)[0]
+    assert int(count) == len(expect)
+    np.testing.assert_array_equal(inds.to_global()[: len(expect)], expect)
+    assert np.all(inds.to_global()[len(expect) :] == 19)
+
+
+def test_invert(rng):
+    grid = Grid.make(2, 2)
+    x = np.array([3, 1, 4, 1, 5], np.int32)
+    act = np.array([True, True, True, True, False])
+    v = DistVec.from_global(grid, x, align="col", fill=0)
+    a = DistVec.from_global(grid, act, align="col", fill=False)
+    out = v.invert(a, out_length=8, sr=SELECT2ND_MIN)
+    # value 1 occurs at indices 1 and 3 -> min resolution picks 1;
+    # value 5 is inactive -> untouched output stays -1
+    expect = np.array([-1, 1, -1, 0, 2, -1, -1, -1], np.int32)
+    np.testing.assert_array_equal(out.to_global(), expect)
+
+
+def test_uniq(rng):
+    grid = Grid.make(2, 2)
+    x = np.array([7, 2, 7, 2, 9, 7], np.int32)
+    act = np.ones(6, bool)
+    v = DistVec.from_global(grid, x, align="col", fill=0)
+    a = DistVec.from_global(grid, act, align="col", fill=False)
+    keep = v.uniq(a).to_global()
+    np.testing.assert_array_equal(keep, [True, True, False, False, True, False])
+
+
+def test_uniq_respects_active(rng):
+    grid = Grid.make(2, 2)
+    x = np.array([7, 2, 7, 2], np.int32)
+    act = np.array([False, True, True, True])
+    v = DistVec.from_global(grid, x, align="col", fill=0)
+    a = DistVec.from_global(grid, act, align="col", fill=False)
+    keep = v.uniq(a).to_global()
+    np.testing.assert_array_equal(keep, [False, True, True, False])
+
+
+def test_randperm():
+    grid = Grid.make(2, 2)
+    p = DistVec.randperm(grid, 23, jax.random.key(7)).to_global()
+    np.testing.assert_array_equal(np.sort(p[:23]), np.arange(23))
+    p2 = DistVec.randperm(grid, 23, jax.random.key(8)).to_global()
+    assert not np.array_equal(p, p2)
+
+
+def test_dense_roundtrip(rng):
+    grid = Grid.make(2, 2)
+    d = rng.random((11, 13)).astype(np.float32)
+    D = DenseParMat.from_global(grid, d)
+    np.testing.assert_allclose(D.to_global(), d)
+
+
+def test_dense_add_spmat(rng):
+    grid = Grid.make(2, 2)
+    d = rng.random((12, 12)).astype(np.float32)
+    s = random_dense(rng, 12, 12, 0.3)
+    D = DenseParMat.from_global(grid, d)
+    S = SpParMat.from_dense(grid, s)
+    np.testing.assert_allclose(
+        D.add_spmat(S).to_global(), d + s, rtol=1e-6
+    )
+
+
+def test_dense_reduce(rng):
+    grid = Grid.make(2, 2)
+    d = rng.random((10, 14)).astype(np.float32)
+    D = DenseParMat.from_global(grid, d)
+    np.testing.assert_allclose(
+        D.reduce(PLUS_TIMES, "rows").to_global(), d.sum(axis=0), rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        D.reduce(PLUS_TIMES, "cols").to_global(), d.sum(axis=1), rtol=1e-5
+    )
+    got = D.reduce(MAX_MIN, "cols").to_global()
+    np.testing.assert_allclose(got, d.max(axis=1), rtol=1e-6)
