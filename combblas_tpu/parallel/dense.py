@@ -108,6 +108,27 @@ class DenseParMat:
         assert (self.nrows, self.ncols) == (S.nrows, S.ncols)
         return _add_spmat_jit(self, S, combine)
 
+    def filter_spmat(self, S: SpParMat, keep) -> SpParMat:
+        """Drop entries of S where ``keep(sval, self[i,j])`` is False.
+
+        The batched-BFS frontier prune of BC: fringe entries whose vertex
+        already has a path count are discarded (reference
+        ``EWiseMult(fringe, nsp, exclude)``, BetwCent.cpp:191-204).
+        """
+        assert self.grid == S.grid
+        assert (self.nrows, self.ncols) == (S.nrows, S.ncols)
+        return _filter_spmat_jit(self, S, keep)
+
+    def scale_spmat(self, S: SpParMat, fn) -> SpParMat:
+        """S with vals ← ``fn(sval, self[i,j])`` (dense-indexed rescale).
+
+        The BC back-propagation weighting (reference ``EWiseScale`` +
+        ``Apply(safemultinv)``, BetwCent.cpp:207-218).
+        """
+        assert self.grid == S.grid
+        assert (self.nrows, self.ncols) == (S.nrows, S.ncols)
+        return _scale_spmat_jit(self, S, fn)
+
     # --- reductions -------------------------------------------------------
 
     def reduce(self, sr: Semiring, axis: str, map_fn=None) -> DistVec:
@@ -128,8 +149,7 @@ def _add_spmat_jit(D: DenseParMat, S: SpParMat, combine) -> DenseParMat:
                 mode="drop",
             )
         else:
-            cur = b[jnp.minimum(t.rows, b.shape[0] - 1),
-                    jnp.minimum(t.cols, b.shape[1] - 1)]
+            cur = _gather_dense_at(b, t)
             new = combine(cur, t.vals.astype(b.dtype))
             out = b.at[t.rows, t.cols].set(
                 jnp.where(t.valid_mask(), new, cur), mode="drop"
@@ -143,6 +163,49 @@ def _add_spmat_jit(D: DenseParMat, S: SpParMat, combine) -> DenseParMat:
         out_specs=TILE_SPEC,
     )(D.blocks, S.rows, S.cols, S.vals, S.nnz)
     return dataclasses.replace(D, blocks=blocks)
+
+
+def _gather_dense_at(b: Array, t) -> Array:
+    """Per-tuple dense values b[t.rows, t.cols] (padding-safe clamp)."""
+    return b[
+        jnp.minimum(t.rows, b.shape[0] - 1),
+        jnp.minimum(t.cols, b.shape[1] - 1),
+    ]
+
+
+@partial(jax.jit, static_argnames=("keep",))
+def _filter_spmat_jit(D: DenseParMat, S: SpParMat, keep) -> SpParMat:
+    def body(blk, rows, cols, vals, nnz):
+        t = S.local_tile(rows, cols, vals, nnz)
+        dval = _gather_dense_at(blk[0, 0], t)
+        return SpParMat._pack_tile(
+            t._select(t.valid_mask() & keep(t.vals, dval))
+        )
+
+    r, c, v, n = jax.shard_map(
+        body,
+        mesh=D.grid.mesh,
+        in_specs=(TILE_SPEC,) * 5,
+        out_specs=(TILE_SPEC,) * 4,
+    )(D.blocks, S.rows, S.cols, S.vals, S.nnz)
+    return dataclasses.replace(S, rows=r, cols=c, vals=v, nnz=n)
+
+
+@partial(jax.jit, static_argnames=("fn",))
+def _scale_spmat_jit(D: DenseParMat, S: SpParMat, fn) -> SpParMat:
+    def body(blk, rows, cols, vals, nnz):
+        t = S.local_tile(rows, cols, vals, nnz)
+        dval = _gather_dense_at(blk[0, 0], t)
+        new = jnp.where(t.valid_mask(), fn(t.vals, dval), t.vals)
+        return SpParMat._pack_tile(dataclasses.replace(t, vals=new))
+
+    r, c, v, n = jax.shard_map(
+        body,
+        mesh=D.grid.mesh,
+        in_specs=(TILE_SPEC,) * 5,
+        out_specs=(TILE_SPEC,) * 4,
+    )(D.blocks, S.rows, S.cols, S.vals, S.nnz)
+    return dataclasses.replace(S, rows=r, cols=c, vals=v, nnz=n)
 
 
 @partial(jax.jit, static_argnames=("sr", "axis", "map_fn"))

@@ -1,0 +1,73 @@
+"""3D grid: SpParMat3D conversions + SUMMA3D vs the 2D product.
+
+Mirrors the reference's SpGEMM3DTest (3D result vs 2D result on the same
+input, ReleaseTests/CMakeLists.txt + SURVEY §4.1-4.2).
+"""
+
+import numpy as np
+import pytest
+
+from combblas_tpu import PLUS_TIMES
+from combblas_tpu.parallel.mesh3d import Grid3D, SpParMat3D, spgemm3d
+from conftest import random_dense
+
+
+@pytest.mark.parametrize("split", ["col", "row"])
+def test_3d_roundtrip(rng, split):
+    grid = Grid3D.make(2, 2, 2)
+    d = random_dense(rng, 16, 16, 0.3)
+    r, c = np.nonzero(d)
+    A = SpParMat3D.from_global_coo(grid, r, c, d[r, c], 16, 16, split=split)
+    np.testing.assert_allclose(A.to_dense(), d, rtol=1e-6)
+    assert int(A.getnnz()) == len(r)
+
+
+def test_summa3d_matches_dense(rng):
+    grid = Grid3D.make(2, 2, 2)
+    da = random_dense(rng, 16, 16, 0.3)
+    db = random_dense(rng, 16, 16, 0.3)
+    ra, ca = np.nonzero(da)
+    rb, cb = np.nonzero(db)
+    A = SpParMat3D.from_global_coo(grid, ra, ca, da[ra, ca], 16, 16, "col")
+    B = SpParMat3D.from_global_coo(grid, rb, cb, db[rb, cb], 16, 16, "row")
+    C = spgemm3d(PLUS_TIMES, A, B)
+    assert C.split == "col"
+    np.testing.assert_allclose(C.to_dense(), da @ db, rtol=1e-5, atol=1e-6)
+
+
+def test_summa3d_single_layer_degenerates(rng):
+    """L=1 must reproduce plain 2D SUMMA semantics."""
+    grid = Grid3D.make(1, 2, 2)
+    da = random_dense(rng, 12, 12, 0.4)
+    db = random_dense(rng, 12, 12, 0.4)
+    ra, ca = np.nonzero(da)
+    rb, cb = np.nonzero(db)
+    A = SpParMat3D.from_global_coo(grid, ra, ca, da[ra, ca], 12, 12, "col")
+    B = SpParMat3D.from_global_coo(grid, rb, cb, db[rb, cb], 12, 12, "row")
+    C = spgemm3d(PLUS_TIMES, A, B)
+    np.testing.assert_allclose(C.to_dense(), da @ db, rtol=1e-5, atol=1e-6)
+
+
+def test_summa3d_rectangular(rng):
+    """A 32x16 · B 16x32 — exercises B's own row blocking in the sizing
+    pass (a bug once used A's)."""
+    grid = Grid3D.make(2, 2, 2)
+    da = random_dense(rng, 32, 16, 0.3)
+    db = random_dense(rng, 16, 32, 0.3)
+    ra, ca = np.nonzero(da)
+    rb, cb = np.nonzero(db)
+    A = SpParMat3D.from_global_coo(grid, ra, ca, da[ra, ca], 32, 16, "col")
+    B = SpParMat3D.from_global_coo(grid, rb, cb, db[rb, cb], 16, 32, "row")
+    C = spgemm3d(PLUS_TIMES, A, B)
+    np.testing.assert_allclose(C.to_dense(), da @ db, rtol=1e-5, atol=1e-6)
+
+
+def test_summa3d_square(rng):
+    """A·A (the MCL expansion shape) through the 3D path."""
+    grid = Grid3D.make(2, 2, 2)
+    d = random_dense(rng, 16, 16, 0.25)
+    r, c = np.nonzero(d)
+    A = SpParMat3D.from_global_coo(grid, r, c, d[r, c], 16, 16, "col")
+    B = SpParMat3D.from_global_coo(grid, r, c, d[r, c], 16, 16, "row")
+    C = spgemm3d(PLUS_TIMES, A, B)
+    np.testing.assert_allclose(C.to_dense(), d @ d, rtol=1e-5, atol=1e-6)
