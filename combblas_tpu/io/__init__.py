@@ -1,0 +1,28 @@
+"""I/O subsystem (≈ SURVEY §2.4): Matrix Market, binary triples, vector I/O.
+
+The reference's I/O layer is native (C ``mmio.c`` + MPI-parallel byte-range
+text ingestion, ``SpParMat::ParallelReadMM`` SpParMat.cpp:3980-4127). Here
+the performance path is a C++ multithreaded parser (``native/mmparse.cpp``)
+loaded via ctypes — built on first use with g++ — with a pure-Python
+fallback so the package works without a toolchain.
+"""
+
+from .mm import (
+    read_mm,
+    read_mm_spmat,
+    write_mm,
+    read_binary,
+    write_binary,
+    read_vec,
+    write_vec,
+)
+
+__all__ = [
+    "read_mm",
+    "read_mm_spmat",
+    "write_mm",
+    "read_binary",
+    "write_binary",
+    "read_vec",
+    "write_vec",
+]

@@ -1,0 +1,240 @@
+"""Matrix Market + binary triple I/O (≈ ParallelReadMM / ParallelWriteMM /
+ParallelBinaryWrite, SpParMat.cpp:3980-4218, :620-714; vector
+ParallelRead/Write, FullyDistSpVec.h:148-154).
+
+Read path: the native C++ parser (``native/mmparse.cpp``, byte-range
+threaded — the FetchBatch scheme) when a toolchain is available, else a
+numpy fallback. Symmetric/skew banners are expanded to full storage, like
+the reference's reader.
+
+Binary format (≈ FileHeader.h:109): 32-byte header
+``b"CBTPUBIN" | uint64 nrows | uint64 ncols | uint64 nnz`` followed by
+int64 rows, int64 cols, float64 vals arrays back to back.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "native")
+_LIB_LOCK = threading.Lock()
+_LIB = None
+_LIB_FAILED = False
+
+_MAGIC = b"CBTPUBIN"
+
+
+def _load_native():
+    """Build (once) and load the C++ parser; None if no toolchain."""
+    global _LIB, _LIB_FAILED
+    if _LIB is not None or _LIB_FAILED:
+        return _LIB
+    with _LIB_LOCK:
+        if _LIB is not None or _LIB_FAILED:
+            return _LIB
+        src = os.path.join(_NATIVE_DIR, "mmparse.cpp")
+        so = os.path.join(_NATIVE_DIR, "libmmparse.so")
+        try:
+            if not os.path.exists(so) or os.path.getmtime(so) < os.path.getmtime(src):
+                subprocess.run(
+                    [
+                        "g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+                        "-pthread", src, "-o", so,
+                    ],
+                    check=True,
+                    capture_output=True,
+                )
+            lib = ctypes.CDLL(so)
+            lib.mm_header.restype = ctypes.c_int
+            lib.mm_header.argtypes = [ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64)]
+            lib.mm_parse.restype = ctypes.c_int64
+            lib.mm_parse.argtypes = [
+                ctypes.c_char_p,
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.POINTER(ctypes.c_double),
+                ctypes.c_int64,
+                ctypes.c_int,
+            ]
+            _LIB = lib
+        except Exception:
+            _LIB_FAILED = True
+            _LIB = None
+    return _LIB
+
+
+def _read_mm_python(path):
+    """Pure-python fallback parser (header + body)."""
+    with open(path, "rb") as f:
+        banner = f.readline().decode()
+        assert banner.startswith("%%MatrixMarket"), f"not MatrixMarket: {path}"
+        b = banner.lower()
+        assert "coordinate" in b, "only coordinate (sparse) format supported"
+        pattern = "pattern" in b
+        sym = (
+            2 if "skew-symmetric" in b else 1 if "symmetric" in b
+            else 3 if "hermitian" in b else 0
+        )
+        line = f.readline().decode()
+        while line.startswith("%"):
+            line = f.readline().decode()
+        nrows, ncols, nnz = (int(x) for x in line.split()[:3])
+        if pattern:
+            data = np.loadtxt(f, dtype=np.int64, usecols=(0, 1), ndmin=2)
+            rows, cols = data[:, 0] - 1, data[:, 1] - 1
+            vals = np.ones(len(rows), np.float64)
+        else:
+            data = np.loadtxt(f, dtype=np.float64, usecols=(0, 1, 2), ndmin=2)
+            rows = data[:, 0].astype(np.int64) - 1
+            cols = data[:, 1].astype(np.int64) - 1
+            vals = data[:, 2]
+    return rows, cols, vals, nrows, ncols, sym
+
+
+def read_mm(path, *, expand_symmetric: bool = True, nthreads: int | None = None):
+    """Parse a Matrix Market coordinate file.
+
+    Returns (rows, cols, vals, nrows, ncols): int64/int64/float64 arrays with
+    symmetric/skew storage expanded to full (off-diagonal mirrored, negated
+    for skew) when ``expand_symmetric``.
+    """
+    lib = _load_native()
+    if lib is not None:
+        hdr = (ctypes.c_int64 * 6)()
+        rc = lib.mm_header(path.encode(), hdr)
+        if rc != 0:
+            raise ValueError(f"mm_header failed ({rc}) for {path}")
+        nrows, ncols, nnz, _pattern, sym, _integer = (int(x) for x in hdr)
+        rows = np.empty(max(nnz, 1), np.int64)
+        cols = np.empty(max(nnz, 1), np.int64)
+        vals = np.empty(max(nnz, 1), np.float64)
+        nt = nthreads or min(os.cpu_count() or 1, 16)
+        got = lib.mm_parse(
+            path.encode(),
+            rows.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            cols.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            vals.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            len(rows),
+            nt,
+        )
+        if got < 0:
+            raise ValueError(f"mm_parse failed ({got}) for {path}")
+        rows, cols, vals = rows[:got], cols[:got], vals[:got]
+    else:
+        rows, cols, vals, nrows, ncols, sym = _read_mm_python(path)
+
+    if expand_symmetric and sym:
+        off = rows != cols
+        mr, mc = cols[off], rows[off]
+        mv = -vals[off] if sym == 2 else vals[off]
+        rows = np.concatenate([rows, mr])
+        cols = np.concatenate([cols, mc])
+        vals = np.concatenate([vals, mv])
+    return rows, cols, vals, nrows, ncols
+
+
+def read_mm_spmat(grid, path, dtype=np.float32, dedup_sr=None, **kw):
+    """read_mm → SpParMat on ``grid`` (the ParallelReadMM equivalent)."""
+    from ..parallel.spmat import SpParMat
+
+    rows, cols, vals, nrows, ncols = read_mm(path, **kw)
+    return SpParMat.from_global_coo(
+        grid, rows, cols, vals.astype(dtype), nrows, ncols, dedup_sr=dedup_sr
+    )
+
+
+def write_mm(path, mat, *, comment: str | None = None):
+    """Write an SpParMat (or (rows, cols, vals, nrows, ncols)) as MM
+    coordinate real general — the ``ParallelWriteMM`` equivalent."""
+    if hasattr(mat, "to_global_coo"):
+        rows, cols, vals = mat.to_global_coo()
+        nrows, ncols = mat.nrows, mat.ncols
+    else:
+        rows, cols, vals, nrows, ncols = mat
+    order = np.lexsort((rows, cols))  # column-major like the reference
+    rows, cols, vals = rows[order], cols[order], vals[order]
+    with open(path, "w") as f:
+        f.write("%%MatrixMarket matrix coordinate real general\n")
+        if comment:
+            for ln in comment.splitlines():
+                f.write(f"% {ln}\n")
+        f.write(f"{nrows} {ncols} {len(rows)}\n")
+    with open(path, "ab") as f:  # vectorized body append
+        np.savetxt(
+            f,
+            np.column_stack(
+                [rows + 1, cols + 1, np.asarray(vals, np.float64)]
+            ),
+            fmt="%d %d %.10g",
+        )
+
+
+def write_binary(path, mat):
+    """Raw binary triple dump (≈ ParallelBinaryWrite, SpParMat.cpp:620-714)."""
+    if hasattr(mat, "to_global_coo"):
+        rows, cols, vals = mat.to_global_coo()
+        nrows, ncols = mat.nrows, mat.ncols
+    else:
+        rows, cols, vals, nrows, ncols = mat
+    with open(path, "wb") as f:
+        f.write(_MAGIC)
+        np.array([nrows, ncols, len(rows)], np.uint64).tofile(f)
+        rows.astype(np.int64).tofile(f)
+        cols.astype(np.int64).tofile(f)
+        vals.astype(np.float64).tofile(f)
+
+
+def read_binary(path):
+    """Inverse of ``write_binary`` → (rows, cols, vals, nrows, ncols)."""
+    with open(path, "rb") as f:
+        assert f.read(8) == _MAGIC, f"bad magic in {path}"
+        nrows, ncols, nnz = (int(x) for x in np.fromfile(f, np.uint64, 3))
+        rows = np.fromfile(f, np.int64, nnz)
+        cols = np.fromfile(f, np.int64, nnz)
+        vals = np.fromfile(f, np.float64, nnz)
+    return rows, cols, vals, nrows, ncols
+
+
+def write_vec(path, vec, active=None):
+    """Text "index value" dump of a DistVec (≈ FullyDistSpVec::ParallelWrite
+    with 1-based ids). ``active`` (bool DistVec) selects a sparse subset."""
+    x = vec.to_global()
+    mask = (
+        np.asarray(active.to_global(), bool)
+        if active is not None
+        else np.ones(len(x), bool)
+    )
+    with open(path, "w") as f:
+        f.write(f"{len(x)} {int(mask.sum())}\n")
+        for i in np.nonzero(mask)[0]:
+            f.write(f"{i + 1} {x[i]}\n")
+
+
+def read_vec(grid, path, dtype=np.float32, align="row", fill=0):
+    """Inverse of ``write_vec`` → (DistVec, active bool DistVec)."""
+    from ..parallel.vec import DistVec
+
+    with open(path) as f:
+        n, _nnz = (int(t) for t in f.readline().split()[:2])
+        vals = np.full(n, fill, dtype)
+        mask = np.zeros(n, bool)
+        for line in f:
+            parts = line.split()
+            if len(parts) < 2:
+                continue
+            raw = int(parts[0])
+            if not (1 <= raw <= n):  # 1-based ids; reject instead of wrapping
+                raise ValueError(
+                    f"vector index {raw} out of range 1..{n} in {path}"
+                )
+            vals[raw - 1] = dtype(parts[1]) if callable(dtype) else parts[1]
+            mask[raw - 1] = True
+    return (
+        DistVec.from_global(grid, vals, align=align, fill=fill),
+        DistVec.from_global(grid, mask, align=align, fill=False),
+    )

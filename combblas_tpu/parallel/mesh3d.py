@@ -272,49 +272,32 @@ def summa3d_spgemm(
     w_out = lcB // L
 
     def body(ar, ac, av, an, br, bc, bv, bn):
+        from .spgemm import _gather_stage_tiles
+
         a_mine = A.local_tile(ar, ac, av, an)
         b_mine = B.local_tile(br, bc, bv, bn)
-        a_g = [lax.all_gather(x, COL_AXIS) for x in
-               (a_mine.rows, a_mine.cols, a_mine.vals, a_mine.nnz)]
-        b_g = [lax.all_gather(x, ROW_AXIS) for x in
-               (b_mine.rows, b_mine.cols, b_mine.vals, b_mine.nnz)]
-        chunks = []
-        for s in range(p):
-            a_s = SpTuples(
-                rows=a_g[0][s], cols=a_g[1][s], vals=a_g[2][s], nnz=a_g[3][s],
-                nrows=a_mine.nrows, ncols=a_mine.ncols,
-            )
-            b_s = SpTuples(
-                rows=b_g[0][s], cols=b_g[1][s], vals=b_g[2][s], nnz=b_g[3][s],
-                nrows=b_mine.nrows, ncols=b_mine.ncols,
-            )
-            chunks.append(
-                esc_expand(sr, a_s, CSR.from_tuples(b_s), flop_capacity)
-            )
+        a_stages = _gather_stage_tiles(a_mine, COL_AXIS, p)
+        b_stages = _gather_stage_tiles(b_mine, ROW_AXIS, p)
+        chunks = [
+            esc_expand(sr, a_stages[s], CSR.from_tuples(b_stages[s]),
+                       flop_capacity)
+            for s in range(p)
+        ]
         partial_c = SpTuples.concat(chunks)  # [lr × lcB] partial, uncompacted
 
-        # Fiber exchange: split local cols into L pieces of width w_out.
+        # Fiber exchange: split local cols into L pieces of width w_out
+        # (the 2D col_split pattern, rebased into piece-local columns).
         piece_arrays = []
         for l_ in range(L):
             lo = l_ * w_out
             keep = (
-                (partial_c.rows < lr)
+                partial_c.valid_mask()
                 & (partial_c.cols >= lo)
                 & (partial_c.cols < lo + w_out)
             )
-            pos = jnp.cumsum(keep.astype(jnp.int32)) - 1
-            scat = jnp.where(keep, pos, piece_capacity)
-            pr_ = jnp.full((piece_capacity,), lr, jnp.int32).at[scat].set(
-                partial_c.rows, mode="drop"
-            )
-            pc_ = jnp.full((piece_capacity,), w_out, jnp.int32).at[scat].set(
-                jnp.where(keep, partial_c.cols - lo, w_out), mode="drop"
-            )
-            pv_ = jnp.zeros((piece_capacity,), partial_c.vals.dtype).at[
-                scat
-            ].set(partial_c.vals, mode="drop")
-            pn_ = jnp.sum(keep).astype(jnp.int32)
-            piece_arrays.append((pr_, pc_, pv_, pn_))
+            sel = partial_c._select(keep).with_capacity(piece_capacity)
+            cols = jnp.where(sel.valid_mask(), sel.cols - lo, w_out)
+            piece_arrays.append((sel.rows, cols, sel.vals, sel.nnz))
 
         stacked = tuple(
             jnp.stack([pa[k] for pa in piece_arrays])
@@ -351,52 +334,70 @@ def summa3d_spgemm(
     )
 
 
+@jax.jit
+def summa3d_stage_flops(A: SpParMat3D, B: SpParMat3D) -> Array:
+    """[p, L, pr, pc] float32 flops per stage per (layer, tile).
+
+    The distributed symbolic pass of the 3D product — same scheme as the 2D
+    ``summa_stage_flops`` (index arrays only cross the ICI), one gather per
+    within-layer axis.
+    """
+    grid = A.grid
+    p = grid.pr
+    lrB = B.tile_rows
+    lrA = A.tile_rows
+    lcA = A.tile_cols
+
+    def body(ar, ac, br):
+        a_rows, a_cols = ar[0, 0, 0], ac[0, 0, 0]
+        b_rows = br[0, 0, 0]
+        ag_rows = lax.all_gather(a_rows, COL_AXIS)
+        ag_cols = lax.all_gather(a_cols, COL_AXIS)
+        bg_rows = lax.all_gather(b_rows, ROW_AXIS)
+        per_stage = []
+        for s in range(p):
+            b_valid = bg_rows[s] < lrB
+            blens = jax.ops.segment_sum(
+                b_valid.astype(jnp.int32), bg_rows[s], num_segments=lrB + 1
+            )
+            a_valid = ag_rows[s] < lrA
+            k = jnp.minimum(ag_cols[s], lrB)
+            per_stage.append(
+                jnp.sum(jnp.where(a_valid, blens[k], 0).astype(jnp.float32))
+            )
+        return jnp.stack(per_stage)[:, None, None, None]
+
+    return jax.shard_map(
+        body,
+        mesh=grid.mesh,
+        in_specs=(TILE3_SPEC,) * 3,
+        out_specs=P(None, LAYER_AXIS, ROW_AXIS, COL_AXIS),
+        check_vma=False,
+    )(A.rows, A.cols, B.rows)
+
+
 def spgemm3d(
     sr: Semiring, A: SpParMat3D, B: SpParMat3D, slack: float = 1.05
 ) -> SpParMat3D:
-    """Unjitted entry: host symbolic sizing → compiled ``summa3d_spgemm``.
+    """Unjitted entry: distributed symbolic sizing → compiled
+    ``summa3d_spgemm``.
 
     The sizing pass mirrors ``EstPerProcessNnzSUMMA``'s role
-    (ParFriends.h:1243) with exact host-side flop counting per
-    (layer, tile, stage); capacities round to powers of two for compile
-    reuse.
+    (ParFriends.h:1243); capacities round to powers of two (clamped to the
+    dense-tile bound) for compile-cache reuse.
     """
-    ar, ac, _ = A.to_global_coo()
-    br, bc, _ = B.to_global_coo()
     grid = A.grid
-    L, p = grid.layers, grid.pr
-    lr = grid.local_rows(A.nrows)
-    lrB_full = grid.local_rows(B.nrows)  # B's own row blocking, not A's
-    lcA = A.tile_cols
-    lrB = B.tile_rows
-    lcB = grid.local_cols(B.ncols)
-
-    # Map each A entry to (layer, i, stage) and count B-row lengths per
-    # (layer, stage, local b-row): flops = Σ_A |B_row(k)|.
-    ati = ar // lr
-    # A col-split local indices:
-    a_lc = ac - (ac // grid.local_cols(A.ncols)) * grid.local_cols(A.ncols)
-    a_layer = a_lc // lcA
-    a_stage = ac // grid.local_cols(A.ncols)
-    # B row-split local indices:
-    b_lr = br - (br // lrB_full) * lrB_full
-    b_layer = b_lr // lrB
-    b_stage = br // lrB_full
-    b_local = b_lr % lrB
-    blen = np.zeros((L, p, lrB), np.int64)
-    np.add.at(blen, (b_layer, b_stage, b_local), 1)
-    a_local_k = a_lc % lcA
-    per_entry = blen[a_layer, a_stage, a_local_k]
-    flops = np.zeros((L, p, p), np.int64)  # (layer, tile row i, stage)
-    np.add.at(flops, (a_layer, ati, a_stage), per_entry)
-    flop_cap = max(int(flops.max() * slack) + 1, 1)
-    total = flops.sum(axis=2)  # per (layer, tile-row): upper bound per tile
+    L = grid.layers
+    per_stage = np.asarray(summa3d_stage_flops(A, B), np.float64)
+    flop_cap = max(int(per_stage.max() * slack) + 1, 1)
+    total = per_stage.sum(axis=0)  # per (layer, tile)
     piece_cap = max(int(total.max() * slack) + 1, 1)
-    out_cap = min(max(int(total.max() * L * slack) + 1, 1), lr * (lcB // L))
+    dense_tile = A.tile_rows * (B.tile_cols // L)
+    out_cap = max(min(int(total.max() * L * slack) + 1, dense_tile), 1)
     rnd = lambda x: 1 << (x - 1).bit_length()
     return summa3d_spgemm(
         sr, A, B,
         flop_capacity=rnd(flop_cap),
-        out_capacity=rnd(out_cap) if out_cap < lr * (lcB // L) else out_cap,
+        out_capacity=min(rnd(out_cap), max(dense_tile, 1)),
         piece_capacity=rnd(piece_cap),
     )
