@@ -39,9 +39,16 @@ def _global_ids(grid, nblocks, block_len, length, align):
     return jnp.where(gids < length, gids, -1)
 
 
-@partial(jax.jit, static_argnums=(2,))
-def bfs(A: SpParMat, source, max_iters: int | None = None):
-    """Level-synchronous BFS from ``source`` over the semiring SELECT2ND_MAX.
+@partial(jax.jit, static_argnames=("max_iters", "sr"))
+def bfs(
+    A: SpParMat,
+    source,
+    max_iters: int | None = None,
+    sr: "Semiring" = SELECT2ND_MAX,
+):
+    """Level-synchronous BFS from ``source`` over a select-style semiring
+    (default SELECT2ND_MAX — structural; pass a value-aware semiring like
+    ``semantic.FILTERED_SELECT2ND_MAX`` for on-the-fly edge filtering).
 
     A is interpreted as: entry (i, j) ≠ 0 means edge j → i (gather from
     in-neighbors, matching the reference's SpMV orientation). Symmetrize for
@@ -76,7 +83,7 @@ def bfs(A: SpParMat, source, max_iters: int | None = None):
     def step(state):
         parents, levels, x, level, _ = state
         unvisited = mk_row(parents < 0)
-        y = dist_spmv_masked(SELECT2ND_MAX, A, mk_col(x), unvisited)
+        y = dist_spmv_masked(sr, A, mk_col(x), unvisited)
         new = (y.blocks >= 0) & (parents < 0) & (row_gids >= 0)
         parents = jnp.where(new, y.blocks, parents)
         levels = jnp.where(new, level + 1, levels)

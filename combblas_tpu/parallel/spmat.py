@@ -213,26 +213,15 @@ class SpParMat:
         ``SparseCommon`` (SpParMat.cpp:2893-2968); the fully on-device
         redistribution lives in ``parallel/redistribute.py``.
         """
-        rows = np.asarray(rows, dtype=np.int64)
-        cols = np.asarray(cols, dtype=np.int64)
         vals = np.asarray(vals)
-        lr, lc = grid.local_rows(nrows), grid.local_cols(ncols)
-        oi = rows // lr
-        oj = cols // lc
-        tile_id = oi * grid.pc + oj
-        order = np.argsort(tile_id, kind="stable")
-        rows, cols, vals, tile_id = (
-            rows[order], cols[order], vals[order], tile_id[order],
+        rows, cols, order, counts, starts, cap, lr, lc = bucket_by_tile(
+            grid, rows, cols, nrows, ncols, capacity
         )
-        counts = np.bincount(tile_id, minlength=grid.size)
-        cap = int(capacity) if capacity is not None else max(int(counts.max()), 1)
-        if counts.max() > cap:
-            raise ValueError(f"tile nnz {counts.max()} exceeds capacity {cap}")
+        vals = vals[order]
         pr_, pc_ = grid.pr, grid.pc
         R = np.full((pr_, pc_, cap), lr, dtype=np.int32)
         C = np.full((pr_, pc_, cap), lc, dtype=np.int32)
         V = np.zeros((pr_, pc_, cap), dtype=vals.dtype)
-        starts = np.concatenate([[0], np.cumsum(counts)])
         for t in range(grid.size):
             i, j = divmod(t, pc_)
             s, e = starts[t], starts[t + 1]
@@ -483,6 +472,29 @@ class SpParMat:
         """
         want_align = "col" if axis == "cols" else "row"
         return _dim_apply_jit(self, vec.realign(want_align), fn, axis)
+
+
+def bucket_by_tile(
+    grid: Grid, rows, cols, nrows: int, ncols: int, capacity: int | None
+):
+    """Shared host bucketing for tile constructors (SpParMat, SemanticGraph).
+
+    Sorts global tuples by owner tile. Returns
+    ``(rows_sorted, cols_sorted, order, counts, starts, cap, lr, lc)``;
+    raises ValueError when an explicit ``capacity`` is too small.
+    """
+    rows = np.asarray(rows, dtype=np.int64)
+    cols = np.asarray(cols, dtype=np.int64)
+    lr, lc = grid.local_rows(nrows), grid.local_cols(ncols)
+    tile_id = (rows // lr) * grid.pc + (cols // lc)
+    order = np.argsort(tile_id, kind="stable")
+    rows, cols = rows[order], cols[order]
+    counts = np.bincount(tile_id, minlength=grid.size)
+    cap = int(capacity) if capacity is not None else max(int(counts.max()), 1)
+    if counts.max() > cap:
+        raise ValueError(f"tile nnz {counts.max()} exceeds capacity {cap}")
+    starts = np.concatenate([[0], np.cumsum(counts)])
+    return rows, cols, order, counts, starts, cap, lr, lc
 
 
 # --- module-level predicates / tile fns (stable identities for jit cache) --

@@ -1,0 +1,181 @@
+"""Semantic (attributed-edge) graphs + runtime edge filters.
+
+The reference attaches a payload struct to every edge (``TwitterEdge.h:15-46``
+— follower count, retweet flag, latest-retweet timestamp), runs BFS/MIS with
+a runtime predicate over it (``FilteredBFS.cpp``, ``FilteredMIS.cpp``), and
+offers two execution modes benchmarked against each other: materialize a
+filtered copy once, or filter on the fly inside the semiring via the
+``returnedSAID()`` do-not-store sentinel (``Semirings.h:36-49``).
+
+TPU-native design: attributes are a struct-of-arrays — one ``[pr, pc, cap]``
+array per field, slot-aligned with the structure matrix's tuples — so a
+predicate is one fused elementwise op over the attribute arrays:
+
+* ``materialize(pred)`` compacts passing entries into a plain SpParMat
+  (the reference's materialized mode);
+* ``mask(pred)`` keeps the layout and writes pred as 0/1 values, paired
+  with a value-aware semiring (``filtered_select2nd_max``) whose ``mul``
+  returns the additive identity on masked-out edges — the structural
+  counterpart of returnedSAID, with zero data movement per filter change.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .semiring import Semiring, _minval
+from .parallel.grid import Grid
+from .parallel.spmat import SpParMat, TILE_SPEC
+from .parallel.vec import DistVec
+
+Array = jax.Array
+
+
+def _sel_zero(dt):
+    return -1 if jnp.issubdtype(jnp.dtype(dt), jnp.signedinteger) else _minval(dt)
+
+
+#: Value-aware BFS semiring: like SELECT2ND_MAX but an edge with value 0
+#: transmits nothing — the on-the-fly filter path (≈ the filtered semiring
+#: over TwitterEdge, FilteredBFS.cpp's on-the-fly mode). The masked branch
+#: returns the additive identity OF X'S DTYPE so mul(a, zero) == zero holds
+#: for every value type, not just int32 parent ids.
+FILTERED_SELECT2ND_MAX = Semiring(
+    name="filtered_select2nd_max",
+    add=jnp.maximum,
+    mul=lambda a, x: jnp.where(a != 0, x, _sel_zero(jnp.asarray(x).dtype)),
+    zero_fn=_sel_zero,
+    one_fn=None,
+    add_kind="max",
+)
+
+
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=["structure", "attrs"],
+    meta_fields=[],
+)
+@dataclasses.dataclass(frozen=True)
+class SemanticGraph:
+    """Structure matrix + slot-aligned attribute arrays.
+
+    ``attrs``: dict field-name → [pr, pc, cap] array, aligned with
+    ``structure``'s tuple slots (≈ SpParMat<.., TwitterEdge, ..> as
+    struct-of-arrays; SemanticGraph.h typedef).
+    """
+
+    structure: SpParMat
+    attrs: dict
+
+    @staticmethod
+    def from_edges(
+        grid: Grid, rows, cols, attrs: dict, nrows: int, ncols: int,
+        capacity: int | None = None,
+    ) -> "SemanticGraph":
+        """Host construction: bucket edges + all attribute columns by owner
+        tile (the SparseCommon shuffle carrying the payload struct)."""
+        from .parallel.spmat import bucket_by_tile
+
+        rows, cols, order, counts, starts, cap, lr, lc = bucket_by_tile(
+            grid, rows, cols, nrows, ncols, capacity
+        )
+        attrs = {k: np.asarray(v)[order] for k, v in attrs.items()}
+        pr_, pc_ = grid.pr, grid.pc
+        R = np.full((pr_, pc_, cap), lr, np.int32)
+        C = np.full((pr_, pc_, cap), lc, np.int32)
+        V = np.zeros((pr_, pc_, cap), np.float32)
+        A = {
+            k: np.zeros((pr_, pc_, cap), v.dtype) for k, v in attrs.items()
+        }
+        for t in range(grid.size):
+            i, j = divmod(t, pc_)
+            s, e = starts[t], starts[t + 1]
+            R[i, j, : e - s] = rows[s:e] - i * lr
+            C[i, j, : e - s] = cols[s:e] - j * lc
+            V[i, j, : e - s] = 1.0
+            for k in attrs:
+                A[k][i, j, : e - s] = attrs[k][s:e]
+        sh = grid.tile_sharding()
+        structure = SpParMat(
+            rows=jax.device_put(jnp.asarray(R), sh),
+            cols=jax.device_put(jnp.asarray(C), sh),
+            vals=jax.device_put(jnp.asarray(V), sh),
+            nnz=jax.device_put(
+                jnp.asarray(counts.reshape(pr_, pc_), jnp.int32), sh
+            ),
+            nrows=int(nrows), ncols=int(ncols), grid=grid,
+        )
+        return SemanticGraph(
+            structure=structure,
+            attrs={k: jax.device_put(jnp.asarray(v), sh) for k, v in A.items()},
+        )
+
+    def materialize(self, pred) -> SpParMat:
+        """Plain SpParMat of edges passing ``pred(attrs_dict) -> bool``.
+
+        The reference's materialized filter (FilteredBFS.cpp's 'Materialize'
+        branch). ``pred`` receives a dict of per-slot arrays.
+        """
+        return _filter_jit(self, pred, "materialize")
+
+    def mask(self, pred) -> SpParMat:
+        """Same structure, values = pred as 0/1 float — pair with
+        ``FILTERED_SELECT2ND_MAX`` (or any value-aware semiring) for
+        on-the-fly filtering without re-layout."""
+        return _filter_jit(self, pred, "mask")
+
+
+@partial(jax.jit, static_argnames=("pred", "mode"))
+def _filter_jit(g: SemanticGraph, pred, mode: str) -> SpParMat:
+    """Shared scaffold for both filter modes: mode="materialize" compacts
+    passing entries, mode="mask" rewrites values to the 0/1 predicate."""
+    S = g.structure
+    names = tuple(sorted(g.attrs))
+
+    def body(rows, cols, vals, nnz, *attr_arrays):
+        t = S.local_tile(rows, cols, vals, nnz)
+        attrs = {k: a[0, 0] for k, a in zip(names, attr_arrays)}
+        ok = t.valid_mask() & pred(attrs)
+        if mode == "materialize":
+            out = t._select(ok)
+        else:
+            out = dataclasses.replace(t, vals=ok.astype(t.vals.dtype))
+        return SpParMat._pack_tile(out)
+
+    r, c, v, n = jax.shard_map(
+        body,
+        mesh=S.grid.mesh,
+        in_specs=(TILE_SPEC,) * (4 + len(names)),
+        out_specs=(TILE_SPEC,) * 4,
+    )(S.rows, S.cols, S.vals, S.nnz, *(g.attrs[k] for k in names))
+    return dataclasses.replace(S, rows=r, cols=c, vals=v, nnz=n)
+
+
+def filtered_bfs(
+    g: SemanticGraph, pred, source, *, materialize: bool = False
+):
+    """BFS over edges passing ``pred`` (≈ FilteredBFS.cpp).
+
+    ``materialize=False`` runs the on-the-fly mode: one elementwise mask
+    pass + the value-aware semiring; ``True`` compacts a filtered copy
+    first (wins when many BFS runs share one filter).
+    Returns (parents, levels, iterations).
+    """
+    from .models.bfs import bfs
+
+    if materialize:
+        return bfs(g.materialize(pred), source)
+    return bfs(g.mask(pred), source, sr=FILTERED_SELECT2ND_MAX)
+
+
+def filtered_mis(g: SemanticGraph, pred, key) -> tuple[DistVec, Array]:
+    """Luby MIS on the filtered graph (≈ FilteredMIS.cpp). The filter is
+    materialized because MIS iterates on the same structure."""
+    from .models.mis import mis
+
+    return mis(g.materialize(pred), key)

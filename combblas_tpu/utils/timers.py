@@ -1,0 +1,81 @@
+"""Phase timers + profiler hooks (≈ the reference's TIMING subsystem).
+
+The reference accumulates global per-phase wall times inside kernels under
+``#ifdef TIMING`` (``CombBLAS.h:77-102``: cblas_alltoalltime /
+allgathertime / localspmvtime / mergeconttime / transvectime, plus the
+mcl_* family) and prints them per app (``TopDownBFS.cpp:472-479``). Under
+XLA, phases inside one compiled program can't be host-timed — the analog
+is (a) named host-side phase accumulation around jitted calls (this module)
+and (b) ``jax.profiler`` traces with named annotations for on-device
+timelines (``trace`` / ``annotate`` below; view in TensorBoard/Perfetto).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import defaultdict
+
+import jax
+
+_ACC: dict[str, float] = defaultdict(float)
+_COUNT: dict[str, int] = defaultdict(int)
+ENABLED = True
+
+
+@contextlib.contextmanager
+def phase(name: str, *, sync=None):
+    """Accumulate wall time under ``name`` (≈ one cblas_* counter).
+
+    ``sync``: optional array/pytree to ``block_until_ready`` before closing
+    the timer, so async dispatch doesn't hide device time.
+    """
+    if not ENABLED:
+        yield
+        return
+    with jax.profiler.TraceAnnotation(name):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            if sync is not None:
+                jax.block_until_ready(sync)
+            _ACC[name] += time.perf_counter() - t0
+            _COUNT[name] += 1
+
+
+def get(name: str) -> float:
+    return _ACC.get(name, 0.0)
+
+
+def report(reset: bool = False) -> dict[str, tuple[float, int]]:
+    """{name: (seconds, calls)} — the per-app timing table the reference
+    prints after each run."""
+    out = {k: (_ACC[k], _COUNT[k]) for k in sorted(_ACC)}
+    if reset:
+        reset_all()
+    return out
+
+
+def reset_all():
+    _ACC.clear()
+    _COUNT.clear()
+
+
+def print_report(reset: bool = False):
+    for k, (sec, n) in report(reset=reset).items():
+        print(f"{k:32s} {sec:10.4f}s  x{n}")
+
+
+@contextlib.contextmanager
+def trace(logdir: str):
+    """Capture a jax.profiler device trace for the enclosed block
+    (TensorBoard/Perfetto — the PAPI/MPI_Pcontrol analog)."""
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+annotate = jax.profiler.TraceAnnotation
