@@ -1,0 +1,319 @@
+"""EllParMat — bucketed sliced-ELL, the gather-only distributed SpMV format.
+
+The reference's answer to SpMV efficiency is DCSC column walks + per-thread
+row splits (``Friends.h:64-180``). On TPU the bottleneck inverts: gathers
+are essentially free (HBM-bandwidth vectorized) while large scatters and
+segmented scans serialize — a 16M-entry segment-max takes seconds where the
+equivalent dense-gather formulation takes 0.05 ms (measured, v5e).
+
+Scale-free graphs defeat plain ELL (one k covers the median but hubs push
+most nnz into an overflow scatter — 61% of scale-19 R-MAT at k=64). The
+fix is degree-bucketed sliced ELL: rows are grouped by power-of-two degree
+class; bucket b stores its rows densely as ``[nb, kb]`` (kb = 2^b), so
+
+* every row's entries live in exactly one bucket (no overflow COO),
+* each bucket's fold is a DENSE reduction over its k axis (VPU-native),
+* results scatter back by unique row ids — an n-sized .set scatter, cheap,
+* total storage is < 2x nnz (kb < 2 x degree).
+
+This is the reference's DER-swap seam (``SpMat.h:54``): same distributed
+schedule (x replicated down grid columns, fold over the "c" axis), local
+kernel chosen by type — ``dist_spmv``/``dist_spmv_masked`` dispatch on the
+matrix type, so SpMV-only algorithms (BFS, CC, SSSP, MIS) accept an
+EllParMat unchanged. Algorithms needing column reductions, apply, or the
+SpMSpV path (PageRank's normalization, bfs_diropt) keep SpParMat.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..ops.segment import segment_reduce
+from ..semiring import Semiring
+from .collectives import axis_reduce
+from .grid import COL_AXIS, ROW_AXIS, Grid
+from .spmat import SpParMat, TILE_SPEC
+from .vec import DistVec
+
+Array = jax.Array
+
+
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=["buckets"],
+    meta_fields=["nrows", "ncols", "grid"],
+)
+@dataclasses.dataclass(frozen=True)
+class EllParMat:
+    """buckets: tuple of (cols [pr,pc,nb,kb], vals [pr,pc,nb,kb],
+    rowids [pr,pc,nb]) — one entry per populated degree class.
+
+    Padding: col slots hold local_cols (gathers the semiring zero), padded
+    bucket rows hold rowid = local_rows (dropped by the result scatter).
+    """
+
+    buckets: tuple
+    nrows: int
+    ncols: int
+    grid: Grid
+
+    @property
+    def local_rows(self) -> int:
+        return self.grid.local_rows(self.nrows)
+
+    @property
+    def local_cols(self) -> int:
+        return self.grid.local_cols(self.ncols)
+
+    @property
+    def dtype(self):
+        return self.buckets[0][1].dtype if self.buckets else jnp.float32
+
+    def getnnz(self) -> Array:
+        lc = self.local_cols
+        return sum(
+            (jnp.sum(bc < lc) for bc, _, _ in self.buckets),
+            start=jnp.int32(0),
+        )
+
+    @staticmethod
+    def from_host_coo(
+        grid: Grid, rows, cols, vals, nrows: int, ncols: int,
+        max_k: int | None = None,
+    ) -> "EllParMat":
+        """Build directly from host global COO — fully numpy + one upload
+        (the only safe construction path for real-chip benchmarking; see
+        the axon D2H note in bench.py).
+
+        ``max_k`` caps a bucket's width; rows with degree > max_k span
+        multiple bucket rows whose partial folds recombine in the result
+        scatter via the semiring add (each entry still appears once).
+        """
+        from .spmat import bucket_by_tile
+
+        vals = np.asarray(vals)
+        rows, cols, order, counts, starts, _cap, lr, lc = bucket_by_tile(
+            grid, rows, cols, nrows, ncols, None
+        )
+        vals = vals[order]
+        pr_, pc_ = grid.pr, grid.pc
+        if max_k is None:
+            max_k = max(int(lc), 1)
+
+        # Per tile: row-sort, then vectorized chunking of every nonempty row
+        # into (class, row, start, take) with take <= max_k.
+        per_tile = []
+        classes = set()
+        for t in range(grid.size):
+            s0, e0 = starts[t], starts[t + 1]
+            r = rows[s0:e0] - (t // pc_) * lr
+            c = cols[s0:e0] - (t % pc_) * lc
+            v = vals[s0:e0]
+            o = np.argsort(r, kind="stable")
+            r, c, v = r[o], c[o], v[o]
+            ptr = np.searchsorted(r, np.arange(lr + 1))
+            deg = ptr[1:] - ptr[:-1]
+            nz = np.nonzero(deg)[0]
+            d_nz, s_nz = deg[nz], ptr[:-1][nz]
+            nchunks = -(-d_nz // max_k)
+            rep_row = np.repeat(nz, nchunks)
+            rep_deg = np.repeat(d_nz, nchunks)
+            rep_start = np.repeat(s_nz, nchunks)
+            # chunk index within each row: global arange minus per-row base
+            base = np.repeat(
+                np.concatenate([[0], np.cumsum(nchunks)])[:-1], nchunks
+            )
+            chunk = np.arange(len(rep_row)) - base
+            take = np.minimum(rep_deg - chunk * max_k, max_k).astype(np.int64)
+            start = rep_start + chunk * max_k
+            cls = np.zeros(len(take), np.int32)
+            big = take > 1
+            cls[big] = np.ceil(np.log2(take[big])).astype(np.int32)
+            classes.update(np.unique(cls).tolist())
+            per_tile.append((cls, rep_row, start, take, c, v))
+
+        buckets = []
+        for b in sorted(classes):
+            kb = 1 << b
+            nb = max(int((pt[0] == b).sum()) for pt in per_tile)
+            nb = max(nb, 1)
+            bc = np.full((pr_, pc_, nb, kb), lc, np.int32)
+            bv = np.zeros((pr_, pc_, nb, kb), vals.dtype)
+            br = np.full((pr_, pc_, nb), lr, np.int32)
+            for t, (cls, rrow, rstart, rtake, c, v) in enumerate(per_tile):
+                i, j = divmod(t, pc_)
+                sel = cls == b
+                if not sel.any():
+                    continue
+                srow, sstart, stake = rrow[sel], rstart[sel], rtake[sel]
+                m = len(srow)
+                # [m, kb] index matrix into the tile's sorted entry arrays
+                idx = sstart[:, None] + np.arange(kb)[None, :]
+                valid = np.arange(kb)[None, :] < stake[:, None]
+                idx = np.where(valid, idx, 0)
+                bc[i, j, :m] = np.where(valid, c[idx], lc)
+                bv[i, j, :m] = np.where(valid, v[idx], 0)
+                br[i, j, :m] = srow
+            sh = grid.tile_sharding()
+            put = lambda x: jax.device_put(jnp.asarray(x), sh)
+            buckets.append((put(bc), put(bv), put(br)))
+        return EllParMat(
+            buckets=tuple(buckets), nrows=int(nrows), ncols=int(ncols),
+            grid=grid,
+        )
+
+    @staticmethod
+    def from_spmat(A: SpParMat, max_k: int | None = None) -> "EllParMat":
+        """Host conversion from an existing SpParMat (one-time per matrix —
+        the kernel-1 pre-pass, like the reference's OptimizeForGraph500,
+        SpParMat.cpp:3343). NOTE: reads the tiles back to host; on the axon
+        chip prefer ``from_host_coo`` before any device work (D2H poison).
+        """
+        r, c, v = A.to_global_coo()
+        return EllParMat.from_host_coo(
+            A.grid, r, c, v, A.nrows, A.ncols, max_k=max_k
+        )
+
+    def reduce(self, sr: Semiring, axis: str, map_fn=None) -> DistVec:
+        """Row-wise fold (axis="cols" → row-aligned vector), e.g. degrees
+        with ``map_fn=ones``. Column-wise reductions should use the SpParMat
+        the ELL was converted from."""
+        assert axis == "cols", "EllParMat.reduce supports axis='cols' only"
+        return _ell_reduce_rows_jit(self, sr, map_fn)
+
+
+def _bucket_fold(sr: Semiring, prods: Array) -> Array:
+    if sr.add_kind == "sum":
+        return jnp.sum(prods, axis=1)
+    if sr.add_kind == "min":
+        return jnp.min(prods, axis=1)
+    if sr.add_kind == "max":
+        return jnp.max(prods, axis=1)
+    return lax.reduce(prods, sr.zero(prods.dtype), sr.add, (1,))
+
+
+def _scatter_rows(sr: Semiring, y: Array, rowids: Array, yb: Array) -> Array:
+    """Combine bucket results into y by row id (padding = lr dropped).
+    Split hub rows may appear twice within a bucket — every path combines
+    duplicates with sr.add (native scatter kinds do; the generic path goes
+    through a duplicate-safe segment reduction)."""
+    if sr.add_kind == "sum":
+        return y.at[rowids].add(yb, mode="drop")
+    if sr.add_kind == "min":
+        return y.at[rowids].min(yb, mode="drop")
+    if sr.add_kind == "max":
+        return y.at[rowids].max(yb, mode="drop")
+    contrib = segment_reduce(sr, yb, rowids, y.shape[0])
+    return sr.add(y, contrib)
+
+
+def _ell_local_spmv(sr: Semiring, buckets, x: Array, lr: int, lc: int) -> Array:
+    """[lr] semiring row fold: per-bucket dense gather+reduce, no big
+    scatter (result writes are one slot per bucket row)."""
+    zero = sr.zero(x.dtype)
+    xpad = jnp.concatenate([x, zero[None]])
+    y = None
+    out_dtype = None
+    for bc, bv, br in buckets:
+        g = xpad[jnp.minimum(bc, lc)]  # [nb, kb]
+        prods = sr.mul(bv, g)
+        yb = _bucket_fold(sr, prods)
+        if y is None:
+            out_dtype = yb.dtype
+            y = jnp.full((lr,), sr.zero(out_dtype), out_dtype)
+        y = _scatter_rows(sr, y, br, yb.astype(out_dtype))
+    if y is None:
+        y = jnp.full((lr,), zero, x.dtype)
+    return y
+
+
+@partial(jax.jit, static_argnames=("sr",))
+def dist_spmv_ell(sr: Semiring, E: EllParMat, x: DistVec) -> DistVec:
+    """y = E ⊗ x — same schedule as ``dist_spmv``, bucketed-ELL kernel."""
+    assert x.length == E.ncols
+    x = x.realign("col")
+    lr, lc = E.local_rows, E.local_cols
+    nb = len(E.buckets)
+
+    def body(xblk, *flat):
+        buckets = [tuple(a[0, 0] for a in flat[3 * i : 3 * i + 3]) for i in range(nb)]
+        y = _ell_local_spmv(sr, buckets, xblk[0], lr, lc)
+        return axis_reduce(sr, y, COL_AXIS)[None]
+
+    flat_args = [a for b in E.buckets for a in b]
+    blocks = jax.shard_map(
+        body,
+        mesh=E.grid.mesh,
+        in_specs=(P(COL_AXIS),) + (TILE_SPEC,) * (3 * nb),
+        out_specs=P(ROW_AXIS),
+    )(x.blocks, *flat_args)
+    return DistVec(blocks=blocks, length=E.nrows, align="row", grid=E.grid)
+
+
+@partial(jax.jit, static_argnames=("sr",))
+def dist_spmv_ell_masked(
+    sr: Semiring, E: EllParMat, x: DistVec, row_active: DistVec
+) -> DistVec:
+    assert x.length == E.ncols
+    x = x.realign("col")
+    row_active = row_active.realign("row")
+    lr, lc = E.local_rows, E.local_cols
+    nb = len(E.buckets)
+
+    def body(xblk, actblk, *flat):
+        buckets = [tuple(a[0, 0] for a in flat[3 * i : 3 * i + 3]) for i in range(nb)]
+        y = _ell_local_spmv(sr, buckets, xblk[0], lr, lc)
+        y = jnp.where(actblk[0], y, sr.zero(y.dtype))
+        return axis_reduce(sr, y, COL_AXIS)[None]
+
+    flat_args = [a for b in E.buckets for a in b]
+    blocks = jax.shard_map(
+        body,
+        mesh=E.grid.mesh,
+        in_specs=(P(COL_AXIS), P(ROW_AXIS)) + (TILE_SPEC,) * (3 * nb),
+        out_specs=P(ROW_AXIS),
+    )(x.blocks, row_active.blocks, *flat_args)
+    return DistVec(blocks=blocks, length=E.nrows, align="row", grid=E.grid)
+
+
+@partial(jax.jit, static_argnames=("sr", "map_fn"))
+def _ell_reduce_rows_jit(E: EllParMat, sr: Semiring, map_fn) -> DistVec:
+    lr, lc = E.local_rows, E.local_cols
+    nb = len(E.buckets)
+
+    def body(*flat):
+        buckets = [tuple(a[0, 0] for a in flat[3 * i : 3 * i + 3]) for i in range(nb)]
+        y = None
+        for bc, bv, br in buckets:
+            valid = bc < lc
+            v = map_fn(bv) if map_fn is not None else bv
+            zero = sr.zero(v.dtype)
+            v = jnp.where(valid, v, zero)
+            yb = _bucket_fold(sr, v)
+            if y is None:
+                y = jnp.full((lr,), zero, v.dtype)
+            y = _scatter_rows(sr, y, br, yb)
+        if y is None:
+            probe = (
+                map_fn(jnp.zeros((), E.dtype))
+                if map_fn is not None
+                else jnp.zeros((), E.dtype)
+            )
+            y = jnp.full((lr,), sr.zero(probe.dtype), probe.dtype)
+        return axis_reduce(sr, y, COL_AXIS)[None]
+
+    flat_args = [a for b in E.buckets for a in b]
+    blocks = jax.shard_map(
+        body,
+        mesh=E.grid.mesh,
+        in_specs=(TILE_SPEC,) * (3 * nb),
+        out_specs=P(ROW_AXIS),
+    )(*flat_args)
+    return DistVec(blocks=blocks, length=E.nrows, align="row", grid=E.grid)

@@ -36,11 +36,17 @@ from .spmat import TILE_SPEC, SpParMat
 from .vec import DistVec
 
 
-def dist_spmv(sr: Semiring, A: SpParMat, x: DistVec) -> DistVec:
+def dist_spmv(sr: Semiring, A, x: DistVec) -> DistVec:
     """y = A ⊗ x over the grid: ``y[i] = ⊕_j A[i,j] ⊗ x[j]``.
 
-    x may be in either alignment; result is row-aligned.
+    x may be in either alignment; result is row-aligned. ``A`` may be an
+    SpParMat or an EllParMat (the gather-only SpMV format) — the DER-swap
+    seam: same schedule, local kernel chosen by type.
     """
+    from .ellmat import EllParMat, dist_spmv_ell
+
+    if isinstance(A, EllParMat):
+        return dist_spmv_ell(sr, A, x)
     assert x.length == A.ncols, (x.length, A.ncols)
     x = x.realign("col")
 
@@ -59,9 +65,11 @@ def dist_spmv(sr: Semiring, A: SpParMat, x: DistVec) -> DistVec:
 
 
 def dist_spmv_masked(
-    sr: Semiring, A: SpParMat, x: DistVec, row_active: DistVec
+    sr: Semiring, A, x: DistVec, row_active: DistVec
 ) -> DistVec:
     """SpMV suppressing rows where ``row_active`` (row-aligned bool) is False.
+
+    ``A`` may be an SpParMat or an EllParMat (see ``dist_spmv``).
 
     The distributed analog of the Graph500 fused kernel's BitMap dedup
     (``BFSFriends.h:59-182``): already-visited vertices never re-enter y.
@@ -69,6 +77,10 @@ def dist_spmv_masked(
     bandwidth semantics-wise (XLA still moves the lane, but the value is the
     identity).
     """
+    from .ellmat import EllParMat, dist_spmv_ell_masked
+
+    if isinstance(A, EllParMat):
+        return dist_spmv_ell_masked(sr, A, x, row_active)
     assert x.length == A.ncols
     x = x.realign("col")
     row_active = row_active.realign("row")

@@ -63,6 +63,48 @@ def rmat_edges(
     return perm[src].astype(jnp.int32), perm[dst].astype(jnp.int32)
 
 
+def rmat_symmetric_coo_host(
+    seed: int, scale: int, edgefactor: int = 16, noise: bool = True
+):
+    """Pure-numpy R-MAT (same kernel as ``rmat_edges``) → symmetrized COO.
+
+    Exists for real-chip benchmarking: the axon TPU runtime permanently
+    degrades launch performance after any device→host readback, so the
+    bench pipeline must construct the graph entirely host-side and only
+    upload (see bench.py). Deterministic in ``seed``.
+    """
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    a, b, c = 0.57, 0.19, 0.19
+    d = 1.0 - a - b - c
+    n = 1 << scale
+    nedges = edgefactor * n
+    # Level-at-a-time generation: [nedges]-sized temporaries instead of
+    # [nedges, scale] (a >10x peak-memory reduction — scale 21 would need
+    # ~25 GB of float64 otherwise), identical output distribution.
+    src = np.zeros(nedges, np.int64)
+    dst = np.zeros(nedges, np.int64)
+    for level in range(scale):
+        u = rng.random(nedges)
+        v = rng.random(nedges)
+        a_eff = a * rng.uniform(0.95, 1.05, nedges) if noise else a
+        ab = a_eff + b
+        src_bit = u >= ab
+        p_dst1 = np.where(src_bit, d / (c + d), b / ab)
+        dst_bit = v < p_dst1
+        w = np.int64(1) << level
+        src += src_bit * w
+        dst += dst_bit * w
+    perm = rng.permutation(n)
+    src, dst = perm[src], perm[dst]
+    keep = src != dst
+    src, dst = src[keep], dst[keep]
+    rows = np.concatenate([src, dst])
+    cols = np.concatenate([dst, src])
+    return rows, cols
+
+
 def rmat_symmetric_coo(key, scale: int, edgefactor: int = 16, noise: bool = True):
     """Edge list → symmetrized COO (both directions, no loops) on host.
 

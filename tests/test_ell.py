@@ -1,0 +1,79 @@
+"""EllParMat: conversion, SpMV across semirings, BFS equivalence."""
+
+import jax
+import numpy as np
+import pytest
+
+from combblas_tpu import MIN_PLUS, PLUS_TIMES, SELECT2ND_MAX
+from combblas_tpu.models.bfs import bfs, traversed_edges
+from combblas_tpu.parallel.ellmat import EllParMat
+from combblas_tpu.parallel.grid import Grid
+from combblas_tpu.parallel.spmat import SpParMat
+from combblas_tpu.parallel.spmv import dist_spmv
+from combblas_tpu.parallel.vec import DistVec
+from conftest import random_dense
+
+
+@pytest.mark.parametrize("pr,pc", [(2, 2), (2, 4)])
+def test_ell_spmv_plus_times(rng, pr, pc):
+    grid = Grid.make(pr, pc)
+    d = random_dense(rng, 20, 24, 0.3)
+    A = SpParMat.from_dense(grid, d)
+    E = EllParMat.from_spmat(A)
+    assert int(E.getnnz()) == int(A.getnnz())
+    x = rng.random(24).astype(np.float32)
+    xv = DistVec.from_global(grid, x, align="col")
+    y = dist_spmv(PLUS_TIMES, E, xv)
+    np.testing.assert_allclose(y.to_global(), d @ x, rtol=1e-5, atol=1e-6)
+
+
+def test_ell_hub_rows_split_across_buckets(rng):
+    """A hub row with degree >> max_k splits over multiple bucket rows whose
+    partial folds recombine exactly."""
+    grid = Grid.make(2, 2)
+    n = 32
+    d = np.zeros((n, n), np.float32)
+    d[0, 1:] = 1.0  # hub row, degree 31
+    d[5, 7] = 2.0
+    A = SpParMat.from_dense(grid, d)
+    E = EllParMat.from_spmat(A, max_k=2)
+    x = rng.random(n).astype(np.float32)
+    y = dist_spmv(PLUS_TIMES, E, DistVec.from_global(grid, x, align="col"))
+    np.testing.assert_allclose(y.to_global(), d @ x, rtol=1e-5, atol=1e-6)
+
+
+def test_ell_min_plus(rng):
+    grid = Grid.make(2, 2)
+    d = random_dense(rng, 12, 12, 0.4)
+    A = SpParMat.from_dense(grid, d)
+    E = EllParMat.from_spmat(A)
+    x = rng.random(12).astype(np.float32)
+    xv = DistVec.from_global(grid, x, align="col", fill=np.float32(np.inf))
+    y1 = dist_spmv(MIN_PLUS, A, xv).to_global()
+    y2 = dist_spmv(MIN_PLUS, E, xv).to_global()
+    np.testing.assert_allclose(y2, y1, rtol=1e-6)
+
+
+def test_ell_bfs_matches_spmat(rng):
+    grid = Grid.make(2, 2)
+    d = (rng.random((24, 24)) < 0.12).astype(np.float32)
+    d = np.maximum(d, d.T)
+    np.fill_diagonal(d, 0)
+    A = SpParMat.from_dense(grid, d)
+    E = EllParMat.from_spmat(A)
+    p1, l1, _ = bfs(A, 0)
+    p2, l2, _ = bfs(E, 0)
+    np.testing.assert_array_equal(l1.to_global(), l2.to_global())
+    np.testing.assert_array_equal(p1.to_global(), p2.to_global())
+    assert int(traversed_edges(A, p1)) == int(traversed_edges(E, p2))
+
+
+def test_ell_row_degrees(rng):
+    from combblas_tpu.parallel.spmat import ones_i32
+
+    grid = Grid.make(2, 2)
+    d = random_dense(rng, 16, 16, 0.3)
+    A = SpParMat.from_dense(grid, d)
+    E = EllParMat.from_spmat(A, max_k=2)  # force hub-row splitting
+    got = E.reduce(PLUS_TIMES, "cols", map_fn=ones_i32).to_global()
+    np.testing.assert_array_equal(got, (d != 0).sum(axis=1))
