@@ -1,0 +1,120 @@
+"""The functor zoo (≈ Operations.h:46-300) as stable module-level callables.
+
+The reference ships a collection of unary/binary functors for Apply/Reduce/
+EWiseApply (maximum, minimum, safemultinv, SetIfNotEqual, bitwise ops,
+sel2nd, totality, exponentiate, RandReduce). Here each is a module-level
+jittable function — which doubles as the compile-cache discipline this
+package asks of callbacks (stable identity → one compiled executable per
+use site; see parallel/spmat.py docstring).
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+
+
+# --- binary fold / combine ops ---------------------------------------------
+
+def maximum(a, b):
+    """≈ maximum<T> (Operations.h:154)."""
+    return jnp.maximum(a, b)
+
+
+def minimum(a, b):
+    """≈ minimum<T> (Operations.h:172)."""
+    return jnp.minimum(a, b)
+
+
+def plus(a, b):
+    return a + b
+
+
+def multiplies(a, b):
+    return a * b
+
+
+def sel1st(a, b):
+    """Keep the first operand."""
+    return a
+
+
+def sel2nd(a, b):
+    """≈ sel2nd (Operations.h) — keep the second operand."""
+    return b
+
+
+def logical_or(a, b):
+    return jnp.logical_or(a != 0, b != 0)
+
+
+def logical_and(a, b):
+    return jnp.logical_and(a != 0, b != 0)
+
+
+def bitwise_or(a, b):
+    """≈ bitwise ops (Operations.h:233-300)."""
+    return a | b
+
+
+def bitwise_and(a, b):
+    return a & b
+
+
+def bitwise_xor(a, b):
+    return a ^ b
+
+
+@lru_cache(maxsize=None)
+def set_if_not_equal(sentinel: float):
+    """≈ SetIfNotEqual (Operations.h:207): keep a where a != sentinel, else
+    take b. Returns a cached closure so each sentinel keys one executable."""
+
+    def f(a, b):
+        return jnp.where(a != sentinel, a, b)
+
+    return f
+
+
+def rand_reduce(key, a, b):
+    """≈ RandReduce (Operations.h:185): pick between operands by a coin
+    flip — callers thread a PRNG key (our deterministic stream analog)."""
+    return jnp.where(jax.random.bernoulli(key, 0.5, jnp.shape(a)), a, b)
+
+
+# --- unary ops --------------------------------------------------------------
+
+def identity(v):
+    return v
+
+
+def safemultinv(v):
+    """≈ safemultinv (Operations.h:103): 1/x with 0 mapped to 0 (the
+    reference maps to numeric max; 0 is the inert choice under our padded
+    representation — MakeColStochastic semantics are unchanged)."""
+    return jnp.where(v != 0, 1.0 / jnp.where(v != 0, v, 1), 0.0)
+
+
+def totality(v):
+    """≈ totality (Operations.h): constant true — structural counting."""
+    return jnp.ones(jnp.shape(v), jnp.bool_)
+
+
+@lru_cache(maxsize=None)
+def exponentiate(power: float):
+    """≈ exponentiate (MCL's inflation functor), cached per power."""
+
+    def f(v):
+        return v**power
+
+    return f
+
+
+def negate(v):
+    return -v
+
+
+def absolute(v):
+    return jnp.abs(v)

@@ -1,0 +1,104 @@
+"""Pallas TPU kernels for semiring-dense hot ops.
+
+The reference's hot loops are hand-written C++ (``mtSpGEMM.h``,
+``Friends.h``); on TPU most of them map best onto XLA's native
+gather/sort/reduce (see ops/ and parallel/ellmat.py). The op XLA genuinely
+lacks is a fused SEMIRING dense matmul: ``C = A ⊗ B`` over (min, +) or
+(max, min) has no MXU lowering, and the naive jnp formulation materializes
+an [m, k, n] broadcast. This Pallas kernel tiles it like a classic blocked
+GEMM — A/B blocks staged in VMEM, the contraction as an in-kernel loop of
+VPU adds/mins over an accumulator — giving dense-block tropical products
+for APSP-style repeated squaring and dense subproblems of semiring SpGEMM.
+
+``plus_times`` is included for completeness (it lowers to the MXU via
+jnp.dot inside the kernel). Use ``interpret=True`` on CPU (tests).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_FOLDS = {
+    "min_plus": (jnp.minimum, jnp.add, jnp.inf),
+    "max_plus": (jnp.maximum, jnp.add, -jnp.inf),
+    "max_min": (jnp.maximum, jnp.minimum, -jnp.inf),
+    "plus_times": (jnp.add, jnp.multiply, 0.0),
+}
+
+
+def _semiring_mm_kernel(a_ref, b_ref, o_ref, *, add, mul, zero, bk: int):
+    @pl.when(pl.program_id(2) == 0)
+    def _init():
+        o_ref[...] = jnp.full_like(o_ref, zero)
+
+    if (add, mul) == (jnp.add, jnp.multiply):
+        o_ref[...] += jnp.dot(
+            a_ref[...], b_ref[...], preferred_element_type=o_ref.dtype
+        )
+        return
+
+    # Chunked static-slice contraction: each step broadcasts a [bm, CH, 1] x
+    # [1, CH, bn] semiring product and folds the CH axis — static shapes
+    # only (Mosaic rejects the dynamic-slice fori formulation), VMEM held to
+    # bm*CH*bn floats per step.
+    CH = 8
+    acc = o_ref[...]
+    for kk0 in range(0, bk, CH):
+        a_blk = a_ref[:, kk0 : kk0 + CH]  # [bm, CH]
+        b_blk = b_ref[kk0 : kk0 + CH, :]  # [CH, bn]
+        prods = mul(a_blk[:, :, None], b_blk[None, :, :])  # [bm, CH, bn]
+        if add is jnp.minimum:
+            step = jnp.min(prods, axis=1)
+        elif add is jnp.maximum:
+            step = jnp.max(prods, axis=1)
+        else:
+            step = jnp.sum(prods, axis=1)
+        acc = add(acc, step)
+    o_ref[...] = acc
+
+
+def semiring_matmul(
+    kind: str,
+    a: jax.Array,
+    b: jax.Array,
+    *,
+    bm: int = 128,
+    bk: int = 128,
+    bn: int = 128,
+    interpret: bool = False,
+) -> jax.Array:
+    """C[i,j] = ⊕_k a[i,k] ⊗ b[k,j] for ``kind`` in {min_plus, max_plus,
+    max_min, plus_times}. Dims must divide by the block sizes (pad with the
+    semiring zero otherwise)."""
+    add, mul, zero = _FOLDS[kind]
+    m, k = a.shape
+    k2, n = b.shape
+    assert k == k2, (a.shape, b.shape)
+    assert m % bm == 0 and k % bk == 0 and n % bn == 0, (
+        f"dims {(m, k, n)} must divide blocks {(bm, bk, bn)}"
+    )
+    grid = (m // bm, n // bn, k // bk)
+    kernel = functools.partial(
+        _semiring_mm_kernel, add=add, mul=mul, zero=zero, bk=bk
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((bm, bk), lambda i, j, kk: (i, kk)),
+            pl.BlockSpec((bk, bn), lambda i, j, kk: (kk, j)),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((m, n), a.dtype),
+        interpret=interpret,
+    )(a, b)
+
+
+def min_plus_matmul(a, b, *, interpret: bool = False) -> jax.Array:
+    """Tropical matmul — the APSP / repeated-squaring building block
+    (dense-block analog of the MIN_PLUS SpGEMM)."""
+    return semiring_matmul("min_plus", a, b, interpret=interpret)

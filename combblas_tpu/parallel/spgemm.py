@@ -271,6 +271,58 @@ def mem_efficient_spgemm(
     return SpParMat.col_concatenate(outs)
 
 
+def block_spgemm(
+    sr: Semiring,
+    A: SpParMat,
+    B: SpParMat,
+    row_blocks: int = 1,
+    col_blocks: int = 1,
+    slack: float = 1.05,
+):
+    """Generator over output blocks: yields ((i, j), C_ij) where
+    C_ij = A[rowblock_i, :] ⊗ B[:, colblock_j].
+
+    Reference: ``BlockSpGEMM`` (BlockSpGEMM.h:16-137) — iterate SUMMA over
+    logical output blocks so no more than one block's expansion is live at
+    a time (out-of-core-style memory bounding; the driver streams blocks to
+    the caller, e.g. for writeout). Splits are LOCAL like col_split;
+    ``SpParMat.col_concatenate`` / stacking reassembles if needed.
+    """
+    a_rows = A.row_split(row_blocks) if row_blocks > 1 else [A]
+    b_cols = B.col_split(col_blocks) if col_blocks > 1 else [B]
+    b_cols = [b.shrink_to_fit() for b in b_cols]  # once, not per row block
+    for i, Ai in enumerate(a_rows):
+        Ai = Ai.shrink_to_fit()
+        for j, Bj in enumerate(b_cols):
+            yield (i, j), spgemm(sr, Ai, Bj, slack)
+
+
+def estimate_flops(A: SpParMat, B: SpParMat) -> int:
+    """Total semiring multiplications of A ⊗ B.
+
+    Reference: ``EstimateFLOP`` (ParFriends.h:356-448) — here the exact
+    distributed symbolic pass summed over stages and tiles.
+    """
+    import numpy as np
+
+    return int(np.asarray(summa_stage_flops(A, B), np.float64).sum())
+
+
+def estimate_nnz_upper(A: SpParMat, B: SpParMat) -> int:
+    """Upper bound on nnz(C): per-tile flops clamped by the dense tile.
+
+    The role of ``EstPerProcessNnzSUMMA``'s estimate (ParFriends.h:1243);
+    exact nnz would need the hash symbolic pass — for capacity sizing the
+    clamped-flops bound is what ``summa_capacities`` already uses.
+    """
+    import numpy as np
+
+    per_stage = np.asarray(summa_stage_flops(A, B), np.float64)
+    per_tile = per_stage.sum(axis=0)
+    dense_tile = A.local_rows * B.local_cols
+    return int(np.minimum(per_tile, dense_tile).sum())
+
+
 def spgemm(
     sr: Semiring,
     A: SpParMat,

@@ -422,6 +422,17 @@ class SpParMat:
         assert lc % nsplits == 0, f"local cols {lc} not divisible by {nsplits}"
         return list(_col_split_jit(self, nsplits))
 
+    def row_split(self, nsplits: int) -> list["SpParMat"]:
+        """Row-wise analog of ``col_split`` (≈ ``Dcsc::RowSplit``,
+        dcsc.h / SpDCCols.h:281-284 — there the OpenMP threading split;
+        here the row-block iterator of BlockSpGEMM)."""
+        lr = self.local_rows
+        assert self.nrows == lr * self.grid.pr, (
+            "row_split requires nrows to divide evenly over the grid"
+        )
+        assert lr % nsplits == 0, f"local rows {lr} not divisible by {nsplits}"
+        return list(_row_split_jit(self, nsplits))
+
     @staticmethod
     def col_concatenate(mats: list["SpParMat"]) -> "SpParMat":
         """Stitch ``col_split`` pieces (or phase outputs) back together.
@@ -800,6 +811,29 @@ def _col_split_jit(mat: SpParMat, nsplits: int):
 
         outs.append(
             _tile_map_jit(mat, f, out_meta=(mat.nrows, lw * mat.grid.pc))
+        )
+    return tuple(outs)
+
+
+@partial(jax.jit, static_argnames=("nsplits",))
+def _row_split_jit(mat: SpParMat, nsplits: int):
+    lr = mat.local_rows
+    lw = lr // nsplits
+    outs = []
+    for s in range(nsplits):
+        lo = s * lw
+
+        def f(t: SpTuples, lo=lo):
+            keep = t.valid_mask() & (t.rows >= lo) & (t.rows < lo + lw)
+            sel = t._select(keep)  # padding already carries (nrows, ncols)
+            rows = jnp.where(sel.valid_mask(), sel.rows - lo, lw)
+            return SpTuples(
+                rows=rows, cols=sel.cols, vals=sel.vals, nnz=sel.nnz,
+                nrows=lw, ncols=t.ncols,
+            )
+
+        outs.append(
+            _tile_map_jit(mat, f, out_meta=(lw * mat.grid.pr, mat.ncols))
         )
     return tuple(outs)
 

@@ -23,6 +23,7 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ..ops.compressed import CSC
@@ -98,6 +99,56 @@ def dist_spmv_masked(
         out_specs=P(ROW_AXIS),
     )(A.rows, A.cols, A.vals, A.nnz, x.blocks, row_active.blocks)
     return DistVec(blocks=blocks, length=A.nrows, align="row", grid=A.grid)
+
+
+@partial(jax.jit, static_argnames=("sr",))
+def dist_spmspv(
+    sr: Semiring,
+    A: SpParMat,
+    x: DistVec,
+    x_active: DistVec,
+) -> tuple[DistVec, DistVec, jax.Array]:
+    """Fully sparse-output distributed SpMSpV.
+
+    The general FullyDistSpVec = SpMV(A, FullyDistSpVec) of the reference
+    (``ParFriends.h:1725-1881``): y's active set is the union of reached
+    rows. Returns (y values row-aligned, y active mask row-aligned, exact
+    global active count) — the dense carrier keeps the representation exact
+    (our masked-dense FullyDistSpVec stance; see parallel/vec.py docstring).
+    """
+    assert x.length == A.ncols
+    lr = A.local_rows
+
+    def mark(rows, cols, vals, nnz, xactblk):
+        t = A.local_tile(rows, cols, vals, nnz)
+        xa = xactblk[0]
+        xapad = jnp.concatenate([xa, jnp.zeros((1,), xa.dtype)])
+        touched = t.valid_mask() & xapad[jnp.minimum(t.cols, xa.shape[0])]
+        local = (
+            jnp.zeros((lr,), jnp.int32)
+            .at[jnp.where(touched, t.rows, lr)]
+            .add(1, mode="drop")
+        )
+        return (lax.psum(local, COL_AXIS) > 0)[None]
+
+    x_active = x_active.realign("col")
+    act_blocks = jax.shard_map(
+        mark,
+        mesh=A.grid.mesh,
+        in_specs=(TILE_SPEC,) * 4 + (P(COL_AXIS),),
+        out_specs=P(ROW_AXIS),
+    )(A.rows, A.cols, A.vals, A.nnz, x_active.blocks)
+    y_active = DistVec(
+        blocks=act_blocks, length=A.nrows, align="row", grid=A.grid
+    )
+    xb = x.realign("col").blocks
+    masked_x = DistVec(
+        blocks=jnp.where(x_active.blocks, xb, sr.zero(xb.dtype)),
+        length=x.length, align="col", grid=A.grid,
+    )
+    y = dist_spmv(sr, A, masked_x)
+    nnz = jnp.sum(act_blocks).astype(jnp.int32)
+    return y, y_active, nnz
 
 
 @partial(
