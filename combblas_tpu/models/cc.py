@@ -86,6 +86,14 @@ def connected_components(A: SpParMat) -> tuple[DistVec, jax.Array]:
     return mk(fb), niter
 
 
+#: LACC (Azad-Buluç IPDPS'19, Applications/CC.h) is the older algorithm the
+#: reference ships alongside FastSV; both share the SpMV<Select2ndMin> +
+#: hooking + shortcutting skeleton and compute identical labelings. FastSV
+#: (same research group's successor) is the single implementation here; the
+#: alias keeps the reference's entry-point name.
+lacc = connected_components
+
+
 def num_components(labels: DistVec) -> int:
     """Host helper: count distinct labels among real (non-padding) slots."""
     import numpy as np

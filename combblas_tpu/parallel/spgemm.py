@@ -308,6 +308,34 @@ def estimate_flops(A: SpParMat, B: SpParMat) -> int:
     return int(np.asarray(summa_stage_flops(A, B), np.float64).sum())
 
 
+def calculate_phases(
+    A: SpParMat, B: SpParMat, per_device_memory_bytes: int,
+    slack: float = 1.05,
+) -> int:
+    """Phase count for ``mem_efficient_spgemm`` from a memory budget.
+
+    Reference: ``CalculateNumberOfPhases`` (ParFriends.h:733-797) — there
+    from ``perProcessMemory`` GB and the SUMMA nnz estimate; here from the
+    peak per-device expansion of the unphased product (stage flops × slot
+    bytes) against the caller's budget, rounded to a divisor-friendly
+    power of two.
+    """
+    import numpy as np
+
+    per_stage = np.asarray(summa_stage_flops(A, B), np.float64)
+    slot_bytes = 4 + 4 + np.dtype(A.dtype).itemsize  # row + col + value
+    peak = per_stage.max() * A.grid.pr * slot_bytes * slack
+    phases = max(1, int(np.ceil(peak / max(per_device_memory_bytes, 1))))
+    phases = 1 << (phases - 1).bit_length()
+    # Clamp to a divisor of B's local column count — a non-divisor would
+    # make mem_efficient_spgemm fall back to unphased, defeating the budget.
+    lc = B.local_cols
+    phases = min(phases, max(lc, 1))
+    while phases > 1 and lc % phases:
+        phases >>= 1
+    return phases
+
+
 def estimate_nnz_upper(A: SpParMat, B: SpParMat) -> int:
     """Upper bound on nnz(C): per-tile flops clamped by the dense tile.
 

@@ -459,6 +459,13 @@ class SpParMat:
         """
         return _reduce_jit(self, sr, axis, map_fn)
 
+    def square(self, sr: Semiring, slack: float = 1.05) -> "SpParMat":
+        """A ⊗ A (≈ ``SpParMat::Square``, SpParMat.cpp:3456 — the MCL
+        expansion step's unphased form)."""
+        from .spgemm import spgemm
+
+        return spgemm(sr, self, self, slack)
+
     # --- transpose --------------------------------------------------------
 
     def transpose(self) -> "SpParMat":

@@ -1,0 +1,114 @@
+"""On-device tuple redistribution (SparseCommon analog) + labeled I/O +
+phase calculator."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from combblas_tpu import PLUS_TIMES
+from combblas_tpu.io.labels import read_labeled_spmat, read_labeled_tuples
+from combblas_tpu.parallel.grid import COL_AXIS, ROW_AXIS, Grid
+from combblas_tpu.parallel.redistribute import (
+    from_device_coo,
+    redistribute_coo,
+)
+from combblas_tpu.parallel.spmat import SpParMat
+from conftest import random_dense
+
+
+def _device_chunks(grid, rows, cols, vals, chunk):
+    """Scatter global tuples round-robin into [pr, pc, chunk] device chunks
+    (simulating per-device generation)."""
+    ndev = grid.size
+    pr_, pc_ = grid.pr, grid.pc
+    R = np.full((ndev, chunk), 1 << 30, np.int32)  # invalid sentinel
+    C = np.full((ndev, chunk), 1 << 30, np.int32)
+    V = np.zeros((ndev, chunk), np.float32)
+    for k in range(len(rows)):
+        d, s = k % ndev, k // ndev
+        R[d, s], C[d, s], V[d, s] = rows[k], cols[k], vals[k]
+    sh = grid.tile_sharding()
+    put = lambda x: jax.device_put(
+        jnp.asarray(x.reshape(pr_, pc_, chunk)), sh
+    )
+    return put(R), put(C), put(V)
+
+
+@pytest.mark.parametrize("pr,pc", [(2, 2), (2, 4)])
+def test_redistribute_matches_host_build(rng, pr, pc):
+    grid = Grid.make(pr, pc)
+    d = random_dense(rng, 16, 16, 0.3)
+    rows, cols = np.nonzero(d)
+    vals = d[rows, cols]
+    chunk = -(-len(rows) // grid.size)
+    R, C, V = _device_chunks(grid, rows, cols, vals, chunk)
+    A = from_device_coo(grid, R, C, V, 16, 16)
+    np.testing.assert_allclose(A.to_dense(), d, rtol=1e-6)
+
+
+def test_redistribute_reports_drops(rng):
+    grid = Grid.make(2, 2)
+    d = random_dense(rng, 12, 12, 0.6)
+    rows, cols = np.nonzero(d)
+    vals = d[rows, cols]
+    chunk = -(-len(rows) // grid.size)
+    R, C, V = _device_chunks(grid, rows, cols, vals, chunk)
+    _, dropped = redistribute_coo(
+        grid, R, C, V, 12, 12, stage_capacity=2, tile_capacity=4
+    )
+    assert int(dropped) > 0  # deliberately starved capacities
+
+
+def test_redistribute_dedup(rng):
+    grid = Grid.make(2, 2)
+    rows = np.array([1, 1, 5, 9])
+    cols = np.array([2, 2, 3, 9])
+    vals = np.array([1.0, 2.0, 5.0, 7.0], np.float32)
+    R, C, V = _device_chunks(grid, rows, cols, vals, 1)
+    A, dropped = redistribute_coo(
+        grid, R, C, V, 12, 12, stage_capacity=8, tile_capacity=8,
+        dedup_sr=PLUS_TIMES,
+    )
+    assert int(dropped) == 0
+    dd = A.to_dense()
+    assert dd[1, 2] == 3.0 and dd[5, 3] == 5.0 and dd[9, 9] == 7.0
+    assert int(A.getnnz()) == 3
+
+
+def test_read_labeled_tuples(tmp_path):
+    p = tmp_path / "net.txt"
+    p.write_text(
+        "# comment\nprotA protB 0.9\nprotB protC\nprotA protC 0.4\n"
+    )
+    rows, cols, vals, labels = read_labeled_tuples(str(p))
+    assert labels == ["protA", "protB", "protC"]
+    np.testing.assert_array_equal(rows, [0, 1, 0])
+    np.testing.assert_array_equal(cols, [1, 2, 2])
+    np.testing.assert_allclose(vals, [0.9, 1.0, 0.4])
+    grid = Grid.make(2, 2)
+    A, labels2 = read_labeled_spmat(grid, str(p), symmetrize=True)
+    d = A.to_dense()
+    assert labels2 == labels
+    assert d[0, 1] == d[1, 0] == np.float32(0.9)
+
+
+def test_calculate_phases(rng):
+    from combblas_tpu.parallel.spgemm import calculate_phases
+
+    grid = Grid.make(2, 2)
+    d = random_dense(rng, 16, 16, 0.4)
+    A = SpParMat.from_dense(grid, d)
+    assert calculate_phases(A, A, 10**9) == 1  # huge budget -> unphased
+    tight = calculate_phases(A, A, 64)
+    assert tight > 1 and (tight & (tight - 1)) == 0  # pow2
+
+
+def test_square(rng):
+    grid = Grid.make(2, 2)
+    d = random_dense(rng, 12, 12, 0.3)
+    A = SpParMat.from_dense(grid, d)
+    np.testing.assert_allclose(
+        A.square(PLUS_TIMES).to_dense(), d @ d, rtol=1e-5, atol=1e-6
+    )
