@@ -31,4 +31,40 @@ from .semiring import (
 from .ops.tuples import SpTuples
 from .ops.compressed import CSC, CSR
 
+# Distributed layer (the reference's public surface).
+from .parallel.grid import Grid
+from .parallel.mesh3d import Grid3D, SpParMat3D, spgemm3d
+from .parallel.dense import DenseParMat
+from .parallel.ellmat import EllParMat
+from .parallel.spmat import SpParMat
+from .parallel.vec import DistVec
+from .parallel.spgemm import (
+    block_spgemm,
+    calculate_phases,
+    estimate_flops,
+    estimate_nnz_upper,
+    mem_efficient_spgemm,
+    spgemm,
+)
+from .parallel.spmv import dist_spmspv, dist_spmv, dist_spmv_masked
+from .parallel.indexing import spasgn, subsref
+from .semantic import SemanticGraph, filtered_bfs, filtered_mis
+
 __version__ = "0.1.0"
+
+__all__ = [
+    # semirings
+    "Semiring", "PLUS_TIMES", "MIN_PLUS", "MAX_MIN", "OR_AND",
+    "SELECT2ND_MAX", "SELECT2ND_MIN", "STANDARD_SEMIRINGS",
+    # local formats
+    "SpTuples", "CSR", "CSC",
+    # distributed objects
+    "Grid", "Grid3D", "SpParMat", "SpParMat3D", "DenseParMat", "EllParMat",
+    "DistVec",
+    # distributed algebra
+    "spgemm", "mem_efficient_spgemm", "block_spgemm", "spgemm3d",
+    "estimate_flops", "estimate_nnz_upper", "calculate_phases",
+    "dist_spmv", "dist_spmv_masked", "dist_spmspv", "subsref", "spasgn",
+    # semantic graphs
+    "SemanticGraph", "filtered_bfs", "filtered_mis",
+]

@@ -68,6 +68,15 @@ def read_labeled_spmat(grid, path, dtype=np.float32, symmetrize=False,
         rows = np.concatenate([rows, mr])
         cols = np.concatenate([cols, mc])
         vals = np.concatenate([vals, mv])
+        # Files often list both directions already; mirroring would then
+        # duplicate coordinates and sum-semiring ops would double weights.
+        # Collapse duplicates keeping the max weight (idempotent when the
+        # two directions agree).
+        key = rows * np.int64(n) + cols
+        order = np.lexsort((-vals, key))
+        key, rows, cols, vals = key[order], rows[order], cols[order], vals[order]
+        first = np.concatenate([[True], key[1:] != key[:-1]])
+        rows, cols, vals = rows[first], cols[first], vals[first]
     A = SpParMat.from_global_coo(
         grid, rows, cols, vals.astype(dtype), n, n, dedup_sr=dedup_sr
     )

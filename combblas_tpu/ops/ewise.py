@@ -60,6 +60,48 @@ def intersect_lookup(a: SpTuples, b: SpTuples, b_zero) -> tuple[Array, Array]:
     return hit, bvals
 
 
+def ewise_apply(
+    a: SpTuples,
+    b: SpTuples,
+    fn,
+    *,
+    allow_a_nulls: bool,
+    allow_b_nulls: bool,
+    a_null,
+    b_null,
+) -> SpTuples:
+    """Generalized elementwise apply with null handling.
+
+    Reference: ``EWiseApply`` (ParFriends.h:2157-2807): the output pattern is
+    the intersection, optionally extended to entries present only in b
+    (``allow_a_nulls`` — a's missing value is ``a_null``) and/or only in a
+    (``allow_b_nulls``). ``fn(a_val, b_val)`` computes kept values. Both
+    tiles must be compacted/duplicate-free. Output capacity is
+    ``a.capacity + b.capacity`` (union bound).
+    """
+    hit_ab, bvals = intersect_lookup(a, b, b_zero=jnp.asarray(b_null, b.vals.dtype))
+    # a-side entries: intersection always; a-only iff allow_b_nulls.
+    keep_a = a.valid_mask() & (hit_ab | allow_b_nulls)
+    vals_a = jnp.where(
+        keep_a, fn(a.vals, jnp.where(hit_ab, bvals, jnp.asarray(b_null, b.vals.dtype))), a.vals
+    )
+    a_side = SpTuples(
+        rows=a.rows, cols=a.cols, vals=vals_a.astype(a.vals.dtype),
+        nnz=a.nnz, nrows=a.nrows, ncols=a.ncols,
+    )._select(keep_a)
+    if not allow_a_nulls:
+        return a_side  # pattern ⊆ a's entries: keep a's capacity
+    # b-only entries.
+    hit_ba, _ = intersect_lookup(b, a, b_zero=jnp.zeros((), a.vals.dtype))
+    keep_b = b.valid_mask() & ~hit_ba
+    vals_b = fn(jnp.asarray(a_null, a.vals.dtype), b.vals)
+    b_side = SpTuples(
+        rows=b.rows, cols=b.cols, vals=vals_b.astype(a.vals.dtype),
+        nnz=b.nnz, nrows=b.nrows, ncols=b.ncols,
+    )._select(keep_b)
+    return SpTuples.concat([a_side, b_side])
+
+
 def ewise_mult(a: SpTuples, b: SpTuples, negate: bool, combine=None) -> SpTuples:
     """A .* structure(B) (negate=False) or A .* ¬structure(B) (negate=True).
 

@@ -69,13 +69,11 @@ def _bucket_route(dest, rows, cols, vals, ndest, cap, pad_row, pad_col):
     bv = jnp.zeros((ndest * cap,), vals.dtype).at[slot].set(
         vals[order], mode="drop"
     )
-    counts = jnp.minimum(within, cap)
     dropped = jnp.sum(jnp.maximum(within - cap, 0))
     return (
         br.reshape(ndest, cap),
         bc.reshape(ndest, cap),
         bv.reshape(ndest, cap),
-        counts,
         dropped,
     )
 
@@ -114,7 +112,7 @@ def redistribute_coo(
         valid = r0 < nrows
         # hop 1: route by owner COLUMN along the "c" axis
         oj = jnp.where(valid, c0 // lc, pc_)
-        br, bc, bv, _cnt, drop1 = _bucket_route(
+        br, bc, bv, drop1 = _bucket_route(
             oj.astype(jnp.int32), r0, c0, v0, pc_, stage_capacity,
             jnp.int32(nrows), jnp.int32(ncols),
         )
@@ -125,7 +123,7 @@ def redistribute_coo(
         # hop 2: route by owner ROW along the "r" axis
         valid1 = r1 < nrows
         oi = jnp.where(valid1, r1 // lr, pr_)
-        br2, bc2, bv2, _cnt2, drop2 = _bucket_route(
+        br2, bc2, bv2, drop2 = _bucket_route(
             oi.astype(jnp.int32), r1, c1, v1, pr_, stage_capacity,
             jnp.int32(nrows), jnp.int32(ncols),
         )
@@ -186,11 +184,13 @@ def from_device_coo(
     ncols: int,
     *,
     slack: float = 2.0,
+    max_retries: int = 3,
     dedup_sr: Semiring | None = None,
 ) -> SpParMat:
     """Convenience wrapper: size capacities from the chunk shape, route,
-    and raise if anything was dropped (callers with skewed inputs should
-    call ``redistribute_coo`` directly with bigger capacities)."""
+    and on drops retry with doubled capacities (skewed inputs — R-MAT hub
+    columns — routinely exceed the balanced-load estimate). Raises only
+    after ``max_retries`` doublings."""
     chunk = rows.shape[-1]
     # hop 2's buckets aggregate up to pc incoming hop-1 buckets, so size the
     # shared stage capacity from the larger of the two hops' balanced loads.
@@ -201,14 +201,19 @@ def from_device_coo(
     )
     # total tuples = chunk * ndev over ndev tiles → ~chunk per tile.
     tile_cap = 1 << max(int(np.ceil(np.log2(max(chunk * slack, 1)))), 0)
-    mat, dropped = redistribute_coo(
-        grid, rows, cols, vals, nrows, ncols,
-        stage_capacity=stage_cap, tile_capacity=tile_cap, dedup_sr=dedup_sr,
-    )
-    nd = int(dropped)
-    if nd:
-        raise ValueError(
-            f"redistribute dropped {nd} tuples; retry with larger "
-            "capacities (redistribute_coo stage_capacity/tile_capacity)"
+    nd = 0
+    for _ in range(max_retries + 1):
+        mat, dropped = redistribute_coo(
+            grid, rows, cols, vals, nrows, ncols,
+            stage_capacity=stage_cap, tile_capacity=tile_cap,
+            dedup_sr=dedup_sr,
         )
-    return mat
+        nd = int(dropped)
+        if nd == 0:
+            return mat
+        stage_cap *= 2
+        tile_cap *= 2
+    raise ValueError(
+        f"redistribute still dropped {nd} tuples after {max_retries} "
+        "capacity doublings; call redistribute_coo with explicit capacities"
+    )

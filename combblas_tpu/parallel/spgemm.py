@@ -320,11 +320,11 @@ def calculate_phases(
     bytes) against the caller's budget, rounded to a divisor-friendly
     power of two.
     """
-    import numpy as np
-
     per_stage = np.asarray(summa_stage_flops(A, B), np.float64)
     slot_bytes = 4 + 4 + np.dtype(A.dtype).itemsize  # row + col + value
-    peak = per_stage.max() * A.grid.pr * slot_bytes * slack
+    # Peak per-device expansion = the worst tile's accumulation over all
+    # SUMMA stages (stage outputs coexist until the merge).
+    peak = per_stage.sum(axis=0).max() * slot_bytes * slack
     phases = max(1, int(np.ceil(peak / max(per_device_memory_bytes, 1))))
     phases = 1 << (phases - 1).bit_length()
     # Clamp to a divisor of B's local column count — a non-divisor would

@@ -302,6 +302,30 @@ class SpParMat:
         assert (self.nrows, self.ncols) == (other.nrows, other.ncols)
         return _ewise_mult_jit(self, other, negate, combine)
 
+    def ewise_apply(
+        self,
+        other: "SpParMat",
+        fn,
+        *,
+        allow_a_nulls: bool = False,
+        allow_b_nulls: bool = False,
+        a_null=0,
+        b_null=0,
+    ) -> "SpParMat":
+        """Generalized elementwise apply with null handling.
+
+        Reference: ``EWiseApply`` (ParFriends.h:2157-2807). The output
+        pattern is the intersection, extended to b-only entries when
+        ``allow_a_nulls`` (missing a reads ``a_null``) and to a-only
+        entries when ``allow_b_nulls``. Local-only (tiles align).
+        """
+        assert self.grid == other.grid
+        assert (self.nrows, self.ncols) == (other.nrows, other.ncols)
+        return _ewise_apply_jit(
+            self, other, fn, allow_a_nulls, allow_b_nulls,
+            float(a_null), float(b_null),
+        )
+
     # --- elementwise union add (matrix +) ---------------------------------
 
     def ewise_add(
@@ -659,6 +683,28 @@ def _tile_zip_jit(a: SpParMat, b: SpParMat, fn) -> SpParMat:
         out_specs=(TILE_SPEC,) * 4,
     )(a.rows, a.cols, a.vals, a.nnz, b.rows, b.cols, b.vals, b.nnz)
     return dataclasses.replace(a, rows=r, cols=c, vals=v, nnz=n)
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "fn", "allow_a_nulls", "allow_b_nulls", "a_null", "b_null",
+    ),
+)
+def _ewise_apply_jit(
+    a: SpParMat, b: SpParMat, fn, allow_a_nulls, allow_b_nulls, a_null,
+    b_null,
+) -> SpParMat:
+    from ..ops.ewise import ewise_apply as _ewise_apply
+
+    def tile_fn(ta, tb):
+        return _ewise_apply(
+            ta, tb, fn,
+            allow_a_nulls=allow_a_nulls, allow_b_nulls=allow_b_nulls,
+            a_null=a_null, b_null=b_null,
+        )
+
+    return _tile_zip_jit(a, b, tile_fn)
 
 
 @partial(jax.jit, static_argnames=("sr", "capacity"))

@@ -1,0 +1,71 @@
+"""Generalized EWiseApply null-handling semantics + Galerkin golden.
+
+Mirrors the reference's EWiseApply variants (ParFriends.h:2157-2807) and
+the GalerkinNew release test (R^T A R via two SpGEMMs).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from combblas_tpu import PLUS_TIMES
+from combblas_tpu.parallel.grid import Grid
+from combblas_tpu.parallel.spgemm import spgemm
+from combblas_tpu.parallel.spmat import SpParMat
+from conftest import random_dense
+
+
+def _sub(a, b):
+    return a - b
+
+
+def _pair(rng, n=12, density=0.3):
+    da = random_dense(rng, n, n, density)
+    db = random_dense(rng, n, n, density)
+    grid = Grid.make(2, 2)
+    return grid, da, db, SpParMat.from_dense(grid, da), SpParMat.from_dense(grid, db)
+
+
+def test_ewise_apply_intersection(rng):
+    grid, da, db, A, B = _pair(rng)
+    got = A.ewise_apply(B, _sub).to_dense()
+    mask = (da != 0) & (db != 0)
+    np.testing.assert_allclose(got, np.where(mask, da - db, 0), rtol=1e-6)
+
+
+def test_ewise_apply_union(rng):
+    grid, da, db, A, B = _pair(rng)
+    got = A.ewise_apply(
+        B, _sub, allow_a_nulls=True, allow_b_nulls=True
+    ).to_dense()
+    mask = (da != 0) | (db != 0)
+    np.testing.assert_allclose(got, np.where(mask, da - db, 0), rtol=1e-6)
+
+
+def test_ewise_apply_difference(rng):
+    """a-only extension: entries of A not in B survive (B reads b_null)."""
+    grid, da, db, A, B = _pair(rng)
+    got = A.ewise_apply(B, _sub, allow_b_nulls=True).to_dense()
+    mask = da != 0
+    np.testing.assert_allclose(got, np.where(mask, da - db * (db != 0) * 1.0, 0) * mask, rtol=1e-6)
+
+
+def test_ewise_apply_b_null_value(rng):
+    grid, da, db, A, B = _pair(rng)
+    got = A.ewise_apply(B, _sub, allow_b_nulls=True, b_null=7.0).to_dense()
+    expect = np.where(
+        da != 0, da - np.where(db != 0, db, 7.0), 0
+    )
+    np.testing.assert_allclose(got, expect.astype(np.float32), rtol=1e-6)
+
+
+def test_galerkin_rtar(rng):
+    """R^T A R — the GalerkinNew release test pattern (RestrictionOp)."""
+    grid = Grid.make(2, 2)
+    da = random_dense(rng, 16, 16, 0.3)
+    dr = random_dense(rng, 16, 8, 0.4)
+    A = SpParMat.from_dense(grid, da)
+    R = SpParMat.from_dense(grid, dr)
+    RT = R.transpose()
+    got = spgemm(PLUS_TIMES, spgemm(PLUS_TIMES, RT, A), R).to_dense()
+    np.testing.assert_allclose(got, dr.T @ da @ dr, rtol=1e-4, atol=1e-4)
