@@ -1,0 +1,71 @@
+"""SUMMA SpGEMM microbenchmark (≈ ReleaseTests/MultTiming.cpp).
+
+A·A on an R-MAT matrix with pre-sized capacities so the timed section is
+the compiled SUMMA only (axon-safe protocol: barrier readback closes the
+timed window). Prints one JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+SCALE = int(os.environ.get("BENCH_SCALE", "14"))
+REPS = int(os.environ.get("BENCH_REPS", "3"))
+
+
+def main():
+    import jax
+    import numpy as np
+
+    from combblas_tpu import PLUS_TIMES
+    from combblas_tpu.parallel.grid import Grid
+    from combblas_tpu.parallel.spgemm import (
+        estimate_flops,
+        summa_capacities,
+        summa_spgemm,
+    )
+    from combblas_tpu.parallel.spmat import SpParMat
+    from combblas_tpu.utils.rmat import rmat_symmetric_coo_host
+
+    grid = Grid.make(1, 1)
+    n = 1 << SCALE
+    rows, cols = rmat_symmetric_coo_host(5, SCALE, 8)
+    key = rows * np.int64(n) + cols
+    uniq = np.unique(key)
+    ru, cu = uniq // n, uniq % n
+    A = SpParMat.from_global_coo(
+        grid, ru, cu, np.ones(len(ru), np.float32), n, n
+    )
+    flops = estimate_flops(A, A)
+    fcap, ocap = summa_capacities(A, A)
+
+    C = summa_spgemm(PLUS_TIMES, A, A, flop_capacity=fcap, out_capacity=ocap)
+    jax.block_until_ready(C.vals)  # warmup/compile
+    time.sleep(2)
+    t0 = time.perf_counter()
+    for _ in range(REPS):
+        C = summa_spgemm(
+            PLUS_TIMES, A, A, flop_capacity=fcap, out_capacity=ocap
+        )
+    _ = float(jax.device_get(C.vals[0, 0, 0]))  # barrier
+    dt = time.perf_counter() - t0
+    print(
+        json.dumps(
+            {
+                "metric": f"spgemm_AxA_rmat_scale{SCALE}_MFLOPs",
+                "value": round(flops * 2 * REPS / dt / 1e6, 2),
+                "unit": "MFLOP/s",
+                "flops": int(flops),
+                "out_nnz": int(jax.device_get(C.getnnz())),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

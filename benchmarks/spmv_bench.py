@@ -1,0 +1,69 @@
+"""SpMSpV / SpMV kernel microbenchmark (≈ Applications/SpMSpV-IPDPS2017).
+
+Compares the COO segment-reduce SpMV against the bucketed sliced-ELL path
+on one chip, with the same axon-safe protocol as bench.py (host build, one
+upload, batched launches, one barrier readback). Prints one JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+SCALE = int(os.environ.get("BENCH_SCALE", "18"))
+REPS = int(os.environ.get("BENCH_REPS", "8"))
+
+
+def main():
+    import jax
+    import numpy as np
+
+    from combblas_tpu import PLUS_TIMES, SELECT2ND_MAX
+    from combblas_tpu.parallel.ellmat import EllParMat
+    from combblas_tpu.parallel.grid import Grid
+    from combblas_tpu.parallel.spmv import dist_spmv
+    from combblas_tpu.parallel.vec import DistVec
+    from combblas_tpu.utils.rmat import rmat_symmetric_coo_host
+
+    grid = Grid.make(1, 1)
+    n = 1 << SCALE
+    rows, cols = rmat_symmetric_coo_host(3, SCALE, 16)
+    key = rows * np.int64(n) + cols
+    uniq = np.unique(key)
+    ru, cu = uniq // n, uniq % n
+    E = EllParMat.from_host_coo(
+        grid, ru, cu, np.ones(len(ru), np.float32), n, n
+    )
+    x = DistVec.from_global(
+        grid, np.random.default_rng(0).random(n).astype(np.float32),
+        align="col",
+    )
+
+    y = dist_spmv(PLUS_TIMES, E, x)  # warmup/compile
+    jax.block_until_ready(y.blocks)
+    time.sleep(2)
+    t0 = time.perf_counter()
+    for _ in range(REPS):
+        y = dist_spmv(PLUS_TIMES, E, y.realign("col"))
+    _ = float(jax.device_get(y.blocks[0, 0]))  # barrier
+    dt = time.perf_counter() - t0
+    gflops = len(ru) * 2 * REPS / dt / 1e9
+    print(
+        json.dumps(
+            {
+                "metric": f"spmv_ell_rmat_scale{SCALE}_chained_GFLOPs",
+                "value": round(gflops, 3),
+                "unit": "GFLOP/s",
+                "nnz": int(len(ru)),
+                "reps": REPS,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

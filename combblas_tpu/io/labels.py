@@ -63,20 +63,18 @@ def read_labeled_spmat(grid, path, dtype=np.float32, symmetrize=False,
     rows, cols, vals, labels = read_labeled_tuples(path)
     n = len(labels)
     if symmetrize:
+        # Mirror off-diagonal edges, but DROP mirrored copies whose
+        # coordinate already appears in the file (files often list both
+        # directions; blindly mirroring would double those weights). Only
+        # mirror-induced duplicates are dropped — genuine same-direction
+        # multi-edges still reach ``dedup_sr`` untouched.
+        orig_keys = np.unique(rows * np.int64(n) + cols)
         off = rows != cols
         mr, mc, mv = cols[off], rows[off], vals[off]
-        rows = np.concatenate([rows, mr])
-        cols = np.concatenate([cols, mc])
-        vals = np.concatenate([vals, mv])
-        # Files often list both directions already; mirroring would then
-        # duplicate coordinates and sum-semiring ops would double weights.
-        # Collapse duplicates keeping the max weight (idempotent when the
-        # two directions agree).
-        key = rows * np.int64(n) + cols
-        order = np.lexsort((-vals, key))
-        key, rows, cols, vals = key[order], rows[order], cols[order], vals[order]
-        first = np.concatenate([[True], key[1:] != key[:-1]])
-        rows, cols, vals = rows[first], cols[first], vals[first]
+        fresh = ~np.isin(mr * np.int64(n) + mc, orig_keys)
+        rows = np.concatenate([rows, mr[fresh]])
+        cols = np.concatenate([cols, mc[fresh]])
+        vals = np.concatenate([vals, mv[fresh]])
     A = SpParMat.from_global_coo(
         grid, rows, cols, vals.astype(dtype), n, n, dedup_sr=dedup_sr
     )

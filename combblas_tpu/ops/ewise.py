@@ -79,12 +79,13 @@ def ewise_apply(
     tiles must be compacted/duplicate-free. Output capacity is
     ``a.capacity + b.capacity`` (union bound).
     """
-    hit_ab, bvals = intersect_lookup(a, b, b_zero=jnp.asarray(b_null, b.vals.dtype))
+    # intersect_lookup fills misses with b_null already.
+    hit_ab, bvals = intersect_lookup(
+        a, b, b_zero=jnp.asarray(b_null, b.vals.dtype)
+    )
     # a-side entries: intersection always; a-only iff allow_b_nulls.
     keep_a = a.valid_mask() & (hit_ab | allow_b_nulls)
-    vals_a = jnp.where(
-        keep_a, fn(a.vals, jnp.where(hit_ab, bvals, jnp.asarray(b_null, b.vals.dtype))), a.vals
-    )
+    vals_a = jnp.where(keep_a, fn(a.vals, bvals), a.vals)
     a_side = SpTuples(
         rows=a.rows, cols=a.cols, vals=vals_a.astype(a.vals.dtype),
         nnz=a.nnz, nrows=a.nrows, ncols=a.ncols,

@@ -233,6 +233,146 @@ class SpParMat3D:
         np.add.at(out, (r, c), v)
         return out
 
+    def shrink_to_fit(self, pow2: bool = True) -> "SpParMat3D":
+        """Host helper: truncate slot capacity to the max tile nnz (pieces
+        from ``col_split`` are front-compacted, so slicing is safe)."""
+        need = max(int(np.max(np.asarray(self.nnz))), 1)
+        if pow2:
+            need = 1 << (need - 1).bit_length()
+        need = min(need, self.capacity)
+        if need == self.capacity:
+            return self
+        return dataclasses.replace(
+            self,
+            rows=self.rows[..., :need],
+            cols=self.cols[..., :need],
+            vals=self.vals[..., :need],
+        )
+
+    # --- local column split / concat (3D phased execution) -----------------
+
+    def col_split(self, nsplits: int) -> list["SpParMat3D"]:
+        """Phase splitter for the 3D product (≈ the per-phase ColSplit of
+        ``MemEfficientSpGEMM3D``, ParFriends.h:3215-3712).
+
+        Row-split matrices only (B's orientation in C = A ⊗ B). The split
+        is STRIDED per layer window: with w = tile_cols/L, piece s takes
+        sub-window [s·w/nsplits, (s+1)·w/nsplits) of EVERY layer window, so
+        the phase outputs of SUMMA3D land fiber-aligned and concatenate
+        without inter-layer movement.
+        """
+        assert self.split == "row", "col_split phases a row-split operand"
+        L = self.grid.layers
+        tc = self.tile_cols
+        assert tc % (L * nsplits) == 0, (
+            f"tile cols {tc} must divide by layers*phases = {L * nsplits}"
+        )
+        assert self.ncols % nsplits == 0
+        return list(_col_split3d_jit(self, nsplits))
+
+    @staticmethod
+    def col_concatenate(mats: list["SpParMat3D"]) -> "SpParMat3D":
+        """Stitch ``col_split`` pieces / SUMMA3D phase outputs back.
+
+        col-split pieces (phase OUTPUTS): per-layer windows are separate
+        array dimensions, so stitching is a plain tile-column offset.
+        row-split pieces (inverting ``col_split``): the strided interleave
+        is undone per layer window.
+        """
+        L = mats[0].grid.layers
+        tcs = [m.tile_cols for m in mats]
+        tc_out = sum(tcs)
+        if mats[0].split == "row":
+            # inverse of the strided col_split: equal windows required
+            assert len(set(tcs)) == 1, "row-split concat needs equal widths"
+        arrays = {"rows": [], "cols": [], "vals": []}
+        nnz = None
+        off = 0
+        for s, (m, tcp) in enumerate(zip(mats, tcs)):
+            valid = m.rows < m.tile_rows
+            if m.split == "col":
+                newcol = m.cols + off  # cumulative: pieces may differ in width
+            else:
+                wp = tcp // L
+                w_out = tc_out // L
+                newcol = (m.cols // wp) * w_out + s * wp + (m.cols % wp)
+            off += tcp
+            arrays["rows"].append(m.rows)
+            arrays["cols"].append(jnp.where(valid, newcol, tc_out))
+            arrays["vals"].append(m.vals)
+            nnz = m.nnz if nnz is None else nnz + m.nnz
+        return dataclasses.replace(
+            mats[0],
+            rows=jnp.concatenate(arrays["rows"], axis=3),
+            cols=jnp.concatenate(arrays["cols"], axis=3),
+            vals=jnp.concatenate(arrays["vals"], axis=3),
+            nnz=nnz,
+            ncols=sum(m.ncols for m in mats),
+        )
+
+
+@partial(jax.jit, static_argnames=("nsplits",))
+def _col_split3d_jit(mat: SpParMat3D, nsplits: int):
+    """Strided per-layer-window selection (see ``col_split`` docstring),
+    batched over the [L, pr, pc] tile axes with one argsort compaction
+    along the slot axis per piece."""
+    tr, tc = mat.tile_rows, mat.tile_cols
+    L = mat.grid.layers
+    w = tc // L  # per-layer output window in the contraction product
+    wp = w // nsplits
+    valid = mat.rows < tr
+    l_win = mat.cols // w
+    within = mat.cols % w
+    outs = []
+    for s in range(nsplits):
+        keep = valid & (within // wp == s)
+        newcol = l_win * wp + (within % wp)
+        piece_tc = L * wp
+        # kept entries first (original order), dropped entries pushed back
+        order = jnp.argsort(jnp.where(keep, 0, 1), axis=3, stable=True)
+        gather = lambda x: jnp.take_along_axis(x, order, axis=3)
+        outs.append(
+            dataclasses.replace(
+                mat,
+                rows=gather(jnp.where(keep, mat.rows, tr)),
+                cols=gather(jnp.where(keep, newcol, piece_tc)),
+                vals=gather(jnp.where(keep, mat.vals, 0)),
+                nnz=jnp.sum(keep, axis=3).astype(jnp.int32),
+                ncols=mat.ncols // nsplits,
+            )
+        )
+    return tuple(outs)
+
+
+def mem_efficient_spgemm3d(
+    sr: Semiring,
+    A: SpParMat3D,
+    B: SpParMat3D,
+    phases: int,
+    *,
+    slack: float = 1.05,
+    prune_fn=None,
+) -> SpParMat3D:
+    """Phased 3D SUMMA: C = A ⊗ B over column chunks of B.
+
+    Reference: ``MemEfficientSpGEMM3D`` (ParFriends.h:3215-3712) — the 3D
+    expansion path of HipMCL with layers > 1: per phase, one SUMMA3D over a
+    column slice of the row-split B, optional prune hook, outputs
+    concatenated. A's gathers repeat per phase (the memory/time trade).
+    """
+    if phases <= 1:
+        C = spgemm3d(sr, A, B, slack)
+        return prune_fn(C) if prune_fn is not None else C
+    outs = []
+    for Bs in B.col_split(phases):
+        # phase pieces inherit B's full slot capacity; truncate so each
+        # SUMMA3D gathers phase-sized arrays (the point of phasing)
+        C = spgemm3d(sr, A, Bs.shrink_to_fit(), slack)
+        if prune_fn is not None:
+            C = prune_fn(C)
+        outs.append(C)
+    return SpParMat3D.col_concatenate(outs)
+
 
 @partial(
     jax.jit,

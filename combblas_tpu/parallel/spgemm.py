@@ -322,9 +322,12 @@ def calculate_phases(
     """
     per_stage = np.asarray(summa_stage_flops(A, B), np.float64)
     slot_bytes = 4 + 4 + np.dtype(A.dtype).itemsize  # row + col + value
-    # Peak per-device expansion = the worst tile's accumulation over all
-    # SUMMA stages (stage outputs coexist until the merge).
-    peak = per_stage.sum(axis=0).max() * slot_bytes * slack
+    # Peak per-device expansion follows the ALLOCATED shapes, not the valid
+    # entries: summa_spgemm pads every one of the p coexisting stage chunks
+    # to flop_capacity = max stage flops (static shapes), so the worst-case
+    # skew allocates p x the single-stage max.
+    p = A.grid.pr
+    peak = per_stage.max() * p * slot_bytes * slack
     phases = max(1, int(np.ceil(peak / max(per_device_memory_bytes, 1))))
     phases = 1 << (phases - 1).bit_length()
     # Clamp to a divisor of B's local column count — a non-divisor would

@@ -8,8 +8,37 @@ import numpy as np
 import pytest
 
 from combblas_tpu import PLUS_TIMES
-from combblas_tpu.parallel.mesh3d import Grid3D, SpParMat3D, spgemm3d
+from combblas_tpu.parallel.mesh3d import (
+    Grid3D,
+    SpParMat3D,
+    mem_efficient_spgemm3d,
+    spgemm3d,
+)
 from conftest import random_dense
+
+
+def test_3d_col_split_concat_roundtrip(rng):
+    grid = Grid3D.make(2, 2, 2)
+    d = random_dense(rng, 16, 16, 0.35)
+    r, c = np.nonzero(d)
+    B = SpParMat3D.from_global_coo(grid, r, c, d[r, c], 16, 16, "row")
+    parts = B.col_split(2)
+    assert all(p.ncols == 8 for p in parts)
+    back = SpParMat3D.col_concatenate(parts)
+    np.testing.assert_allclose(back.to_dense(), d, rtol=1e-6)
+
+
+@pytest.mark.parametrize("phases", [2, 4])
+def test_mem_efficient_spgemm3d(rng, phases):
+    grid = Grid3D.make(2, 2, 2)
+    da = random_dense(rng, 16, 16, 0.3)
+    db = random_dense(rng, 16, 16, 0.3)
+    ra, ca = np.nonzero(da)
+    rb, cb = np.nonzero(db)
+    A = SpParMat3D.from_global_coo(grid, ra, ca, da[ra, ca], 16, 16, "col")
+    B = SpParMat3D.from_global_coo(grid, rb, cb, db[rb, cb], 16, 16, "row")
+    C = mem_efficient_spgemm3d(PLUS_TIMES, A, B, phases)
+    np.testing.assert_allclose(C.to_dense(), da @ db, rtol=1e-5, atol=1e-6)
 
 
 @pytest.mark.parametrize("split", ["col", "row"])
