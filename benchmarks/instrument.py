@@ -1,0 +1,332 @@
+"""Single-experiment BFS/SpMV instrumentation probe (axon-safe).
+
+Usage:  python benchmarks/instrument.py EXPERIMENT [ARGS...]
+
+Each invocation runs ONE experiment in a fresh process and prints one JSON
+line. Fresh-process isolation matters: on this chip any device->host
+readback permanently degrades subsequent launches (~1000x, see bench.py
+module docstring), so a probe gets exactly one timed section, closed by a
+single scalar D2H (the only trustworthy synchronization point through the
+axon tunnel — block_until_ready returns in microseconds regardless of
+in-flight work).
+
+Experiments (scale/edgefactor via BENCH_SCALE / BENCH_EDGEFACTOR):
+
+  chain K R        R launches of a K-level fused BFS-step loop (lax.fori_loop,
+                   no early exit — dense-regime level cost is frontier-
+                   independent). Varying (K, R) at constant K*R separates
+                   per-launch dispatch overhead from per-level kernel time.
+  kernel VARIANT R one launch, R chained iterations of a local-kernel piece:
+                   full     = gather + semiring fold + row scatter (the real
+                              ELL local SpMV, level-equivalent minus realign)
+                   fold     = gather + fold only (scatter replaced by a sum)
+                   scatter  = row scatter only (folded values precomputed)
+  membw MB R       one launch, R chained sums over an MB-megabyte f32 array:
+                   achieved HBM read bandwidth reference.
+
+These are the "which phase is slow" numbers VERDICT r1 asked for; results
+are committed to benchmarks/results/instrument_r2.json by the driver.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+SCALE = int(os.environ.get("BENCH_SCALE", "19"))
+EDGEFACTOR = int(os.environ.get("BENCH_EDGEFACTOR", "16"))
+
+
+def build_graph():
+    import numpy as np
+
+    from combblas_tpu.utils.rmat import rmat_symmetric_coo_host
+
+    n = 1 << SCALE
+    rows, cols = rmat_symmetric_coo_host(42, SCALE, EDGEFACTOR)
+    key = rows * np.int64(n) + cols
+    uniq = np.unique(key)
+    rows_u = (uniq // n).astype(np.int64)
+    cols_u = (uniq % n).astype(np.int64)
+    return rows_u, cols_u, n
+
+
+def upload_ell():
+    import numpy as np
+
+    from combblas_tpu.parallel.ellmat import EllParMat
+    from combblas_tpu.parallel.grid import Grid
+
+    rows_u, cols_u, n = build_graph()
+    grid = Grid.make(1, 1)
+    E = EllParMat.from_host_coo(
+        grid, rows_u, cols_u, np.ones(len(rows_u), np.float32), n, n
+    )
+    return E, n, len(rows_u)
+
+
+def ell_bytes(E) -> int:
+    """HBM bytes read per full ELL SpMV (cols + vals once, ignoring the
+    x-gather reuse and y writes — a lower bound on traffic)."""
+    total = 0
+    for bc, bv, br in E.buckets:
+        total += bc.size * 4 + bv.size * 4 + br.size * 4
+    return total
+
+
+def timed(launch_fn, n_launches: int, sync_fn):
+    """Run launch_fn() n_launches times, close with sync_fn() (one D2H)."""
+    t0 = time.perf_counter()
+    out = None
+    for _ in range(n_launches):
+        out = launch_fn(out)
+    sync_fn(out)
+    return time.perf_counter() - t0
+
+
+def exp_chain(K: int, R: int):
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from combblas_tpu.parallel.ellmat import dist_spmv_ell_masked
+    from combblas_tpu.parallel.vec import DistVec
+    from combblas_tpu.semiring import SELECT2ND_MAX
+
+    E, n, nnz = upload_ell()
+    grid = E.grid
+    lr = grid.local_rows(n)
+    row_gids = jnp.arange(lr, dtype=jnp.int32).reshape(1, lr)
+
+    def mk(b, align):
+        return DistVec(blocks=b, length=n, align=align, grid=grid)
+
+    @jax.jit
+    def chainK(parents, x):
+        def body(_, st):
+            parents, x = st
+            unvisited = mk(parents < 0, "row")
+            y = dist_spmv_ell_masked(SELECT2ND_MAX, E, mk(x, "col"), unvisited)
+            new = (y.blocks >= 0) & (parents < 0)
+            parents = jnp.where(new, y.blocks, parents)
+            x = mk(jnp.where(new, row_gids, -1), "row").realign("col").blocks
+            return parents, x
+
+        return lax.fori_loop(0, K, body, (parents, x))
+
+    parents0 = jnp.where(row_gids == 0, 0, -1).astype(jnp.int32)
+    x0 = jnp.where(row_gids == 0, 0, -1).astype(jnp.int32)
+    # warmup compile
+    p, x = chainK(parents0, x0)
+    jax.block_until_ready((p, x))
+    time.sleep(3.0)
+
+    def launch(prev):
+        if prev is None:
+            prev = (parents0, x0)
+        return chainK(*prev)
+
+    dt = timed(launch, R, lambda out: int(jax.device_get(out[0][0, 0])))
+    return {
+        "experiment": f"chain K={K} R={R}",
+        "levels": K * R,
+        "launches": R,
+        "dt_s": round(dt, 4),
+        "ms_per_level": round(dt / (K * R) * 1e3, 3),
+        "nnz": nnz,
+        "ell_bytes_per_level": ell_bytes(E),
+        "achieved_GBps": round(ell_bytes(E) * K * R / dt / 1e9, 2),
+    }
+
+
+def exp_kernel(variant: str, R: int):
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from combblas_tpu.parallel.ellmat import (
+        _bucket_fold,
+        _ell_local_spmv,
+        _scatter_rows,
+    )
+    from combblas_tpu.semiring import SELECT2ND_MAX
+
+    E, n, nnz = upload_ell()
+    sr = SELECT2ND_MAX
+    lr = E.local_rows
+    lc = E.local_cols
+    # strip the [pr, pc] tile dims — single-device local arrays
+    buckets = [(bc[0, 0], bv[0, 0].astype(jnp.int32), br[0, 0]) for bc, bv, br in E.buckets]
+    nb_tot = sum(b[0].shape[0] for b in buckets)
+
+    if variant == "full":
+
+        @jax.jit
+        def run(x):
+            def body(_, x):
+                y = _ell_local_spmv(sr, buckets, x, lr, lc)
+                return jnp.where(y >= 0, y, x)  # data dependence
+
+            return lax.fori_loop(0, R, body, x)
+
+    elif variant == "fold":
+
+        @jax.jit
+        def run(x):
+            def body(_, x):
+                zero = sr.zero(x.dtype)
+                xpad = jnp.concatenate([x, zero[None]])
+                acc = jnp.int32(0)
+                for bc, bv, br in buckets:
+                    g = xpad[jnp.minimum(bc, lc)]
+                    prods = sr.mul(bv, g)
+                    yb = _bucket_fold(sr, prods)
+                    acc = acc + jnp.sum(yb)
+                return x.at[0].set(acc)  # data dependence, no scatter
+
+            return lax.fori_loop(0, R, body, x)
+
+    elif variant == "scatter":
+        ybs = [jnp.zeros((b[0].shape[0],), jnp.int32) for b in buckets]
+
+        @jax.jit
+        def run(x):
+            def body(_, x):
+                y = jnp.full((lr,), sr.zero(jnp.int32), jnp.int32)
+                for (bc, bv, br), yb in zip(buckets, ybs):
+                    y = _scatter_rows(sr, y, br, yb + x[0])
+                return jnp.maximum(y, x)
+
+            return lax.fori_loop(0, R, body, x)
+
+    else:
+        raise SystemExit(f"unknown kernel variant {variant}")
+
+    x0 = jnp.full((lc,), -1, jnp.int32).at[0].set(0)
+    out = run(x0)
+    jax.block_until_ready(out)
+    time.sleep(3.0)
+
+    dt = timed(lambda prev: run(x0 if prev is None else prev), 1,
+               lambda out: int(jax.device_get(out[0])))
+    return {
+        "experiment": f"kernel {variant} R={R}",
+        "iters": R,
+        "dt_s": round(dt, 4),
+        "ms_per_iter": round(dt / R * 1e3, 3),
+        "nnz": nnz,
+        "n_buckets": len(buckets),
+        "bucket_rows_total": int(nb_tot),
+        "ell_bytes": ell_bytes(E),
+        "achieved_GBps": round(ell_bytes(E) * R / dt / 1e9, 2),
+    }
+
+
+def exp_membw(mb: int, R: int):
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    n = mb * 1024 * 1024 // 4
+    a = jnp.arange(n, dtype=jnp.float32)
+
+    @jax.jit
+    def run(s):
+        def body(_, s):
+            return s + jnp.sum(a + s)
+
+        return lax.fori_loop(0, R, body, s)
+
+    out = run(jnp.float32(0))
+    jax.block_until_ready(out)
+    time.sleep(3.0)
+    dt = timed(lambda prev: run(jnp.float32(0)), 1,
+               lambda out: float(jax.device_get(out)))
+    return {
+        "experiment": f"membw {mb}MB R={R}",
+        "dt_s": round(dt, 4),
+        "ms_per_iter": round(dt / R * 1e3, 3),
+        "achieved_GBps": round(mb / 1024 * R / dt, 1),
+    }
+
+
+def main():
+    exp = sys.argv[1]
+    if exp == "chain":
+        out = exp_chain(int(sys.argv[2]), int(sys.argv[3]))
+    elif exp == "kernel":
+        out = exp_kernel(sys.argv[2], int(sys.argv[3]))
+    elif exp == "membw":
+        out = exp_membw(int(sys.argv[2]), int(sys.argv[3]))
+    elif exp == "membw2":
+        out = exp_membw2(int(sys.argv[2]), int(sys.argv[3]))
+    elif exp == "args":
+        out = exp_args(int(sys.argv[2]), int(sys.argv[3]))
+    else:
+        raise SystemExit(f"unknown experiment {exp}")
+    out["scale"] = SCALE
+    print(json.dumps(out))
+
+
+
+
+def exp_args(mb: int, R: int):
+    """Trivial kernel over an MB-sized resident argument, R launches:
+    if per-launch time scales with MB, the tunnel streams arguments per
+    launch (the fixed-cost hypothesis for the BFS gap)."""
+    import jax
+    import jax.numpy as jnp
+
+    n = mb * 1024 * 1024 // 4
+    a = jax.device_put(jnp.ones((n,), jnp.float32))
+
+    @jax.jit
+    def run(a, s):
+        return a[:8].sum() + s
+
+    out = run(a, jnp.float32(0))
+    jax.block_until_ready(out)
+    time.sleep(3.0)
+    dt = timed(lambda prev: run(a, prev if prev is not None else jnp.float32(0)),
+               R, lambda out: float(jax.device_get(out)))
+    return {
+        "experiment": f"args {mb}MB R={R}",
+        "dt_s": round(dt, 4),
+        "ms_per_launch": round(dt / R * 1e3, 3),
+        "implied_stream_MBps": round(mb * R / dt, 1),
+    }
+
+
+def exp_membw2(mb: int, R: int):
+    """HBM bandwidth: array passed as ARGUMENT (not closure constant —
+    closures get embedded in the compile request, which the remote-compile
+    endpoint rejects >~100MB)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    n = mb * 1024 * 1024 // 4
+    a = jax.device_put(jnp.ones((n,), jnp.float32))
+
+    @jax.jit
+    def run(a, s):
+        def body(_, s):
+            return s + jnp.sum(a * (1.0 + s * 1e-30))
+        return lax.fori_loop(0, R, body, s)
+
+    out = run(a, jnp.float32(0))
+    jax.block_until_ready(out)
+    time.sleep(3.0)
+    dt = timed(lambda prev: run(a, jnp.float32(0)), 1,
+               lambda out: float(jax.device_get(out)))
+    return {
+        "experiment": f"membw2 {mb}MB R={R}",
+        "dt_s": round(dt, 4),
+        "ms_per_iter": round(dt / R * 1e3, 3),
+        "achieved_GBps": round(mb / 1024 * R / dt, 1),
+    }
+
+if __name__ == "__main__":
+    main()

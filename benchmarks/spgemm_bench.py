@@ -25,9 +25,9 @@ def main():
     from combblas_tpu import PLUS_TIMES
     from combblas_tpu.parallel.grid import Grid
     from combblas_tpu.parallel.spgemm import (
-        estimate_flops,
-        summa_capacities,
+        summa_capacities_host,
         summa_spgemm,
+        summa_stage_flops_host,
     )
     from combblas_tpu.parallel.spmat import SpParMat
     from combblas_tpu.utils.rmat import rmat_symmetric_coo_host
@@ -38,11 +38,17 @@ def main():
     key = rows * np.int64(n) + cols
     uniq = np.unique(key)
     ru, cu = uniq // n, uniq % n
+    # Symbolic sizing on HOST from the COO (axon-safe: the device symbolic
+    # pass would need a D2H readback before the timed launches, which
+    # permanently degrades them — see bench.py module docstring).
+    per_stage = summa_stage_flops_host(grid, ru, cu, ru, cu, n, n, n)
+    flops = int(per_stage.sum())
+    fcap, ocap = summa_capacities_host(
+        grid, ru, cu, ru, cu, n, n, n, per_stage=per_stage
+    )
     A = SpParMat.from_global_coo(
         grid, ru, cu, np.ones(len(ru), np.float32), n, n
     )
-    flops = estimate_flops(A, A)
-    fcap, ocap = summa_capacities(A, A)
 
     C = summa_spgemm(PLUS_TIMES, A, A, flop_capacity=fcap, out_capacity=ocap)
     jax.block_until_ready(C.vals)  # warmup/compile

@@ -232,7 +232,25 @@ def read_vec(grid, path, dtype=np.float32, align="row", fill=0):
                 raise ValueError(
                     f"vector index {raw} out of range 1..{n} in {path}"
                 )
-            vals[raw - 1] = dtype(parts[1]) if callable(dtype) else parts[1]
+            tok = parts[1]
+            # Parse numerically first: np.bool_("False") is True (any
+            # non-empty string is truthy), which silently corrupted bool
+            # round-trips through write_vec.
+            if tok in ("True", "False"):
+                v = tok == "True"
+            else:
+                try:
+                    v = int(tok)  # exact for int64-range values
+                except ValueError:
+                    v = float(tok)
+                    if np.issubdtype(vals.dtype, np.integer):
+                        # Keep the old loud failure: silently truncating
+                        # 3.7 -> 3 into an int vector corrupts data.
+                        raise ValueError(
+                            f"non-integer value {tok!r} for integer dtype "
+                            f"{vals.dtype} in {path}"
+                        )
+            vals[raw - 1] = v
             mask[raw - 1] = True
     return (
         DistVec.from_global(grid, vals, align=align, fill=fill),

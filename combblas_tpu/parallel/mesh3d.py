@@ -360,6 +360,33 @@ def mem_efficient_spgemm3d(
     column slice of the row-split B, optional prune hook, outputs
     concatenated. A's gathers repeat per phase (the memory/time trade).
     """
+    L = B.grid.layers
+    assert B.split == "row", (
+        "mem_efficient_spgemm3d phases the row-split operand B; got "
+        f"split={B.split!r} (build B with split='row')"
+    )
+
+    def _splittable(ph: int) -> bool:
+        return B.tile_cols % (L * ph) == 0 and B.ncols % ph == 0
+
+    if phases > 1 and not _splittable(phases):
+        # Snap DOWN to the nearest valid phase count: running unphased would
+        # discard the caller's memory bound entirely, while a smaller valid
+        # split preserves most of it.
+        snapped = max(
+            (ph for ph in range(phases - 1, 0, -1) if _splittable(ph)),
+            default=1,
+        )
+        import warnings
+
+        warnings.warn(
+            f"mem_efficient_spgemm3d: tile_cols={B.tile_cols} / "
+            f"ncols={B.ncols} not splittable into {phases} phases with "
+            f"{L} layers (needs tile_cols % (layers*phases) == 0 and "
+            f"ncols % phases == 0); snapping to {snapped} phases",
+            stacklevel=2,
+        )
+        phases = snapped
     if phases <= 1:
         C = spgemm3d(sr, A, B, slack)
         return prune_fn(C) if prune_fn is not None else C

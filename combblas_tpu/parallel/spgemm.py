@@ -208,6 +208,14 @@ def summa_stage_flops(A: SpParMat, B: SpParMat) -> jax.Array:
     )(A.rows, A.cols, B.rows)
 
 
+def _caps_from_stage_flops(per_stage: np.ndarray, dense_tile: int,
+                           slack: float):
+    flop_cap = max(int(per_stage.max() * slack) + 1, 1)
+    total_per_tile = per_stage.sum(axis=0).max()
+    out_cap = max(min(int(total_per_tile * slack) + 1, dense_tile), 1)
+    return flop_cap, out_cap
+
+
 def summa_capacities(A: SpParMat, B: SpParMat, slack: float = 1.05):
     """Host helper: symbolic pass → (flop_capacity, out_capacity).
 
@@ -215,13 +223,73 @@ def summa_capacities(A: SpParMat, B: SpParMat, slack: float = 1.05):
     max per-tile total flops (a product has at most one output per flop),
     clamped to the dense tile size. ``slack`` covers the float32 rounding of
     the counts plus headroom for reusing compiled code across inputs.
+
+    NOTE: reads the device symbolic pass back to host — on the axon chip
+    use ``summa_capacities_host`` from the host COO *before* any device
+    work (D2H poison, see bench.py).
     """
     per_stage = np.asarray(summa_stage_flops(A, B), dtype=np.float64)
-    flop_cap = max(int(per_stage.max() * slack) + 1, 1)
-    total_per_tile = per_stage.sum(axis=0).max()
-    dense_tile = A.local_rows * B.local_cols
-    out_cap = max(min(int(total_per_tile * slack) + 1, dense_tile), 1)
-    return flop_cap, out_cap
+    return _caps_from_stage_flops(
+        per_stage, A.local_rows * B.local_cols, slack
+    )
+
+
+def summa_stage_flops_host(
+    grid, rows_a, cols_a, rows_b, cols_b,
+    nrows_a: int, ncols_a: int, ncols_b: int,
+) -> np.ndarray:
+    """Host-numpy twin of ``summa_stage_flops``: [p, pr, pc] flop counts
+    computed from global COO arrays, with zero device interaction.
+
+    For benchmarking on hardware where any D2H readback degrades later
+    launches, the symbolic sizing must happen before upload; this computes
+    the identical per-stage per-tile counts from the same owner math.
+    """
+    pr_, pc_ = grid.pr, grid.pc
+    assert pr_ == pc_, "SUMMA requires a square grid"
+    p = pr_
+    lrA = grid.local_rows(nrows_a)
+    lcA = grid.local_cols(ncols_a)
+    lrB = grid.local_rows(ncols_a)
+    lcB = grid.local_cols(ncols_b)
+    assert lcA == lrB, "A col-blocking must equal B row-blocking"
+    rows_a = np.asarray(rows_a, np.int64)
+    cols_a = np.asarray(cols_a, np.int64)
+    rows_b = np.asarray(rows_b, np.int64)
+    cols_b = np.asarray(cols_b, np.int64)
+    # countA[i, s, k] = nnz of A-tile (i,s) in local column k
+    ia, sa, ka = rows_a // lrA, cols_a // lcA, cols_a % lcA
+    countA = np.bincount(
+        (ia * p + sa) * lcA + ka, minlength=p * p * lcA
+    ).reshape(p, p, lcA)
+    # countB[s, j, k] = nnz of B-tile (s,j) in local row k
+    sb, jb, kb = rows_b // lrB, cols_b // lcB, rows_b % lrB
+    countB = np.bincount(
+        (sb * p + jb) * lrB + kb, minlength=p * p * lrB
+    ).reshape(p, p, lrB)
+    # flops[s, i, j] = sum_k countA[i,s,k] * countB[s,j,k]
+    return np.einsum(
+        "isk,sjk->sij", countA.astype(np.float64), countB.astype(np.float64)
+    )
+
+
+def summa_capacities_host(
+    grid, rows_a, cols_a, rows_b, cols_b,
+    nrows_a: int, ncols_a: int, ncols_b: int, slack: float = 1.05,
+    per_stage: np.ndarray | None = None,
+):
+    """Host-only twin of ``summa_capacities`` (flop_capacity, out_capacity)
+    from global COO arrays — the public entry for D2H-sensitive callers
+    (benchmarks on the axon chip size capacities before any upload).
+
+    Pass a precomputed ``per_stage`` (from ``summa_stage_flops_host``) to
+    avoid recomputing the O(nnz) symbolic pass."""
+    if per_stage is None:
+        per_stage = summa_stage_flops_host(
+            grid, rows_a, cols_a, rows_b, cols_b, nrows_a, ncols_a, ncols_b
+        )
+    dense_tile = grid.local_rows(nrows_a) * grid.local_cols(ncols_b)
+    return _caps_from_stage_flops(per_stage, dense_tile, slack)
 
 
 def mem_efficient_spgemm(

@@ -321,9 +321,13 @@ class SpParMat:
         """
         assert self.grid == other.grid
         assert (self.nrows, self.ncols) == (other.nrows, other.ncols)
+        # Nulls stay exact in the operand dtypes (hashable python scalars):
+        # float() would corrupt int64 nulls beyond float64's exact range
+        # and bool/object payload conventions.
         return _ewise_apply_jit(
             self, other, fn, allow_a_nulls, allow_b_nulls,
-            float(a_null), float(b_null),
+            np.asarray(a_null, self.dtype).item(),
+            np.asarray(b_null, other.dtype).item(),
         )
 
     # --- elementwise union add (matrix +) ---------------------------------

@@ -35,12 +35,20 @@ def _meta_of(obj) -> dict:
             "grid": [obj.grid.pr, obj.grid.pc],
         }
     if isinstance(obj, DistVec):
-        return {
+        meta = {
             "kind": "DistVec",
             "length": obj.length,
             "align": obj.align,
             "grid": [obj.grid.pr, obj.grid.pc],
         }
+        # Persist the padding fill so cross-grid restore can rebuild blocks
+        # whose padding slots fold correctly (e.g. -1 parents, -inf maxima).
+        # Only the LAST element is read (always a padding slot when padding
+        # exists) — not the whole vector.
+        pa, L = obj.blocks.shape
+        if pa * L > obj.length:
+            meta["fill"] = np.asarray(obj.blocks[-1, -1]).item()
+        return meta
     raise TypeError(f"unsupported checkpoint object: {type(obj)}")
 
 
@@ -88,12 +96,40 @@ def load(path: str, grid: Grid):
                 grid, rows, cols, vals, meta["nrows"], meta["ncols"]
             )
         if meta["kind"] == "DistVec":
-            blocks = z["blocks"]
-            flat = blocks.reshape(-1)[: meta["length"]]
-            return DistVec.from_global(
-                grid, flat, align=meta["align"],
-            )
+            return _restore_vec(np.asarray(z["blocks"]), meta, grid)
         raise TypeError(meta["kind"])
+
+
+def _restore_vec(blocks: np.ndarray, meta: dict, grid: Grid) -> DistVec:
+    """Rebuild a DistVec preserving padding fill values.
+
+    Matching grid shape → the saved padded blocks are device_put verbatim
+    (padding slots keep whatever fill the vector was built with — reduce()
+    folds padding, so 0-filling a -1/-inf-padded vector would corrupt it).
+    Different shape → rebuild from the global values with the persisted
+    fill (0 only when the saved vector had no padding slot to sample).
+    """
+    pr, pc = meta["grid"]
+    pa = pr if meta["align"] == "row" else pc
+    pa_now = grid.pr if meta["align"] == "row" else grid.pc
+    if pa == pa_now and blocks.shape[0] == pa_now:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..parallel.grid import COL_AXIS, ROW_AXIS
+
+        sh = NamedSharding(
+            grid.mesh, P(ROW_AXIS if meta["align"] == "row" else COL_AXIS)
+        )
+        return DistVec(
+            blocks=jax.device_put(jnp.asarray(blocks), sh),
+            length=meta["length"], align=meta["align"], grid=grid,
+        )
+    flat = blocks.reshape(-1)[: meta["length"]]
+    fill = meta.get("fill")
+    return DistVec.from_global(
+        grid, flat, align=meta["align"],
+        fill=np.asarray(fill, dtype=blocks.dtype) if fill is not None else 0,
+    )
 
 
 def _npz_to_tuples(z, meta):
@@ -159,7 +195,5 @@ def load_orbax(path: str, grid: Grid):
             nrows=meta["nrows"], ncols=meta["ncols"], grid=grid,
         )
     if meta["kind"] == "DistVec":
-        blocks = np.asarray(state["blocks"])
-        flat = blocks.reshape(-1)[: meta["length"]]
-        return DistVec.from_global(grid, flat, align=meta["align"])
+        return _restore_vec(np.asarray(state["blocks"]), meta, grid)
     raise TypeError(meta["kind"])

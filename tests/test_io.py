@@ -141,3 +141,18 @@ def test_vec_roundtrip(tmp_path, rng):
     v2, a2 = read_vec(grid, path, align="row")
     np.testing.assert_array_equal(a2.to_global(), act)
     np.testing.assert_allclose(v2.to_global()[act], x[act], rtol=1e-6)
+
+
+def test_vec_roundtrip_bool(tmp_path, rng):
+    """Bool vectors must survive write_vec/read_vec (ADVICE r1:
+    np.bool_('False') is True, so token parsing must be numeric-first)."""
+    grid = Grid.make(2, 2)
+    x = rng.random(11) < 0.5
+    x[0] = False  # ensure at least one explicit False among actives
+    act = np.ones(11, bool)
+    v = DistVec.from_global(grid, x, align="row", fill=False)
+    a = DistVec.from_global(grid, act, align="row", fill=False)
+    path = str(tmp_path / "bv.txt")
+    write_vec(path, v, active=a)
+    v2, _ = read_vec(grid, path, dtype=np.bool_, align="row", fill=False)
+    np.testing.assert_array_equal(np.asarray(v2.to_global(), bool), x)

@@ -119,3 +119,25 @@ def test_checkpoint_orbax_roundtrip(tmp_path, rng):
     ckpt.save_orbax(p, A)
     B = ckpt.load_orbax(p, grid)
     np.testing.assert_allclose(B.to_dense(), d)
+
+
+def test_checkpoint_vec_preserves_fill(tmp_path):
+    """Restored vectors must keep their padding fill (ADVICE r1): a MAX
+    reduce over an all-negative vector restored with 0-padding would
+    silently return 0."""
+    from combblas_tpu.semiring import SELECT2ND_MAX
+
+    grid = Grid.make(2, 2)
+    x = -np.arange(2, 9, dtype=np.int32)  # 7 values, all negative
+    v = DistVec.from_global(grid, x, align="row", fill=np.int32(-(2**31)))
+    p = str(tmp_path / "negvec.npz")
+    ckpt.save(p, v)
+    # same-shape restore: padded blocks verbatim
+    v2 = ckpt.load(p, grid)
+    assert int(v2.reduce(SELECT2ND_MAX)) == -2
+    np.testing.assert_array_equal(v2.to_global(), x)
+    # cross-shape restore: fill persisted through meta
+    g2 = Grid.make(4, 2)
+    v3 = ckpt.load(p, g2)
+    assert int(v3.reduce(SELECT2ND_MAX)) == -2
+    np.testing.assert_array_equal(v3.to_global(), x)
