@@ -6,18 +6,28 @@ Prints ONE JSON line:
 Protocol (adapted from the reference's TopDownBFS driver,
 TopDownBFS.cpp:421-479): R-MAT scale-S graph (edgefactor 16, symmetrized,
 deloop'd, dedup'd), BFS from NROOTS random reachable roots, AGGREGATE MTEPS
-over the batch (sum of kernel-2 traversed edges / total batch wall time).
+over the batch (sum of kernel-2 traversed edges / total wall time).
 NOTE: the Graph500 spec and the archived baseline use harmonic-mean
-per-root TEPS; per-root timing needs trustworthy per-launch sync, which
-this device does not provide (see below), so the aggregate — which
-amortizes launch overhead across roots — is reported instead and
-vs_baseline should be read with that caveat.
+per-root TEPS; per-root timing needs per-launch sync, which this device
+does not provide trustworthily, so the aggregate — which amortizes launch
+overhead across roots — is reported instead, with that caveat.
+
+DESIGN (round 2, from the measured probe decomposition in
+benchmarks/results/instrument_r2_raw*.txt):
+  * per-launch dispatch through the axon tunnel costs ~105 ms regardless
+    of resident argument bytes → the WHOLE batch is ONE launch;
+  * the ELL SpMV kernel is gather-bound at ~130M indices/s, and a gather's
+    cost is per-INDEX: fetching W=64 payload lanes costs only ~2x one lane
+    (gatherw probes) → all NROOTS=64 BFS trees advance together as one
+    [n, 64] frontier matrix (bfs_batch; SURVEY §2.3 strategy 7), so the
+    per-index cost is split 64 ways;
+  * kernel-2 TEPS accounting runs on device (batch_traversed_edges); the
+    only D2H is one [W] vector + the sync scalar, AFTER timing.
 
 AXON D2H NOTE: this chip's runtime permanently degrades launch performance
 (~1000x) after ANY device->host readback, so the pipeline is strictly
 phased: (1) host-numpy graph construction + ELL bucketing, (2) one upload,
-(3) timed BFS launches synchronized only via block_until_ready, (4) all
-readbacks (TEPS accounting, validation) after timing.
+(3) ONE timed launch closed by the te readback (the only reliable sync).
 
 vs_baseline compares single-chip MTEPS against the smallest archived
 reference run: 1,636 MTEPS on 1,024 Hopper (Cray XE6) cores
@@ -32,7 +42,7 @@ import time
 
 SCALE = int(os.environ.get("BENCH_SCALE", "19"))
 EDGEFACTOR = int(os.environ.get("BENCH_EDGEFACTOR", "16"))
-NROOTS = int(os.environ.get("BENCH_NROOTS", "8"))
+NROOTS = int(os.environ.get("BENCH_NROOTS", "64"))
 BASELINE_MTEPS = 1636.0  # Hopper 1024 cores, R-MAT "mini"
 
 
@@ -40,9 +50,10 @@ def main():
     import jax
     import numpy as np
 
-    from combblas_tpu.models.bfs import bfs
+    from combblas_tpu.models.bfs import batch_traversed_edges, bfs_batch
     from combblas_tpu.parallel.ellmat import EllParMat
     from combblas_tpu.parallel.grid import Grid
+    from combblas_tpu.parallel.vec import DistVec
     from combblas_tpu.utils.rmat import rmat_symmetric_coo_host
 
     grid = Grid.make(1, 1)
@@ -64,31 +75,29 @@ def main():
     E = EllParMat.from_host_coo(
         grid, rows_u, cols_u, np.ones(nnz, np.float32), n, n
     )
+    deg_blocks = DistVec.from_global(
+        grid, deg.astype(np.int32), align="row"
+    ).blocks
+    roots_dev = jax.device_put(np.asarray(roots, np.int32))
 
-    # --- Phase 3: timed launches ------------------------------------------
-    # block_until_ready does not reliably synchronize through the axon
-    # tunnel (launches appear to complete in microseconds), so the timed
-    # section is the WHOLE batch of BFS launches closed by one scalar D2H —
-    # the only true synchronization point. The D2H's poison (see module
-    # docstring) then only affects the post-timing accounting phase, and
-    # its ~5 ms latency inflates dt, biasing the reported TEPS DOWN.
-    p, _, _ = bfs(E, int(roots[0]))  # compile warmup
-    jax.block_until_ready(p.blocks)
-    time.sleep(3.0)  # drain any in-flight warmup work
+    # --- Phase 3: ONE timed launch ----------------------------------------
+    # Warmup compiles the whole batched program; block_until_ready is not a
+    # reliable barrier through the tunnel, so sleep covers the drain and the
+    # timed section is closed by the te readback (its ~5 ms inflates dt,
+    # biasing reported TEPS DOWN).
+    p, _, _ = bfs_batch(E, roots_dev)
+    te_dev = batch_traversed_edges(deg_blocks, p)
+    jax.block_until_ready(te_dev)
+    time.sleep(5.0)
 
     t0 = time.perf_counter()
-    results = []
-    for r in roots:
-        parents, _, _ = bfs(E, int(r))
-        results.append(parents)
-    _sync = int(jax.device_get(results[-1].blocks[0, 0]))  # true barrier
+    parents, _, _ = bfs_batch(E, roots_dev)
+    te_dev = batch_traversed_edges(deg_blocks, parents)
+    te = np.asarray(jax.device_get(te_dev))  # true barrier
     dt_total = time.perf_counter() - t0
 
-    # --- Phase 4: readbacks / accounting ----------------------------------
-    total_te = 0
-    for parents in results:
-        disc = parents.to_global() >= 0
-        total_te += int(deg[disc].sum()) // 2
+    # --- Phase 4: accounting ----------------------------------------------
+    total_te = int(te.sum())
     mteps = total_te / dt_total / 1e6
     print(
         json.dumps(

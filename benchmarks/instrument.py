@@ -21,8 +21,25 @@ Experiments (scale/edgefactor via BENCH_SCALE / BENCH_EDGEFACTOR):
                               ELL local SpMV, level-equivalent minus realign)
                    fold     = gather + fold only (scatter replaced by a sum)
                    scatter  = row scatter only (folded values precomputed)
-  membw MB R       one launch, R chained sums over an MB-megabyte f32 array:
-                   achieved HBM read bandwidth reference.
+  membw MB R       BROKEN for useful sizes: the array is a jit-closure
+                   constant, embedded in the remote-compile request, which
+                   rejects bodies >~100MB (HTTP 413). Kept for the record;
+                   use membw2.
+  membw2 MB R      HBM read-bandwidth reference; array passed as an
+                   argument (resident), R chained sums in one launch.
+  args MB R        R launches of a trivial kernel over an MB-sized resident
+                   argument: separates fixed dispatch cost from any
+                   per-launch argument streaming (measured: ~105 ms fixed,
+                   no streaming).
+  gatherw W R      one launch, R iterations of the full bucket gather with
+                   W payload lanes per index ([lc+1, W] table): the
+                   multi-root batching question (measured: W=8 costs the
+                   same as W=1; W=64 costs ~2x).
+  pallas_gather R [W]  Mosaic 2D-gather feasibility probe (take_along_axis
+                   from a VMEM table). NOTE arg order: R first, then W
+                   (default 128). Currently fails lowering: Mosaic's
+                   dynamic-gather is register-block-local, not a
+                   large-table gather.
 
 These are the "which phase is slow" numbers VERDICT r1 asked for; results
 are committed to benchmarks/results/instrument_r2.json by the driver.
@@ -264,6 +281,11 @@ def main():
         out = exp_membw2(int(sys.argv[2]), int(sys.argv[3]))
     elif exp == "args":
         out = exp_args(int(sys.argv[2]), int(sys.argv[3]))
+    elif exp == "gatherw":
+        out = exp_gatherw(int(sys.argv[2]), int(sys.argv[3]))
+    elif exp == "pallas_gather":
+        out = exp_pallas_gather(int(sys.argv[2]),
+                                int(sys.argv[3]) if len(sys.argv) > 3 else 128)
     else:
         raise SystemExit(f"unknown experiment {exp}")
     out["scale"] = SCALE
@@ -327,6 +349,115 @@ def exp_membw2(mb: int, R: int):
         "ms_per_iter": round(dt / R * 1e3, 3),
         "achieved_GBps": round(mb / 1024 * R / dt, 1),
     }
+
+
+def exp_gatherw(W: int, R: int):
+    """Width-batched gather: g = x2[idx] where x2 is [lc+1, W] — the
+    multi-source-BFS amortization question. If dt(W=8) ~= dt(W=1), the
+    gather cost is per-INDEX, and batching 8 BFS roots into one frontier
+    matrix makes each gathered index fetch 8 lanes of payload ~free."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    E, n, nnz = upload_ell()
+    lc = E.local_cols
+    buckets = [(bc[0, 0], br[0, 0]) for bc, _, br in E.buckets]
+
+    @jax.jit
+    def run(x2):
+        def body(_, x2):
+            acc = jnp.zeros((W,), jnp.int32)
+            for bc, _br in buckets:
+                g = x2[jnp.minimum(bc, lc)]  # [nb, kb, W]
+                acc = acc + jnp.max(jnp.max(g, axis=1), axis=0)
+            return x2.at[0].set(acc)
+
+        return lax.fori_loop(0, R, body, x2)
+
+    x0 = jnp.tile(jnp.arange(lc + 1, dtype=jnp.int32)[:, None], (1, W))
+    out = run(x0)
+    jax.block_until_ready(out)
+    time.sleep(3.0)
+    dt = timed(lambda prev: run(x0 if prev is None else prev), 1,
+               lambda out: int(jax.device_get(out[0, 0])))
+    slots = sum(bc.size for bc, _ in buckets)
+    return {
+        "experiment": f"gatherw W={W} R={R}",
+        "iters": R,
+        "dt_s": round(dt, 4),
+        "ms_per_iter": round(dt / R * 1e3, 3),
+        "gather_slots": int(slots),
+        "Mindex_per_s": round(slots * R / dt / 1e6, 1),
+        "payload_GBps": round(slots * W * 4 * R / dt / 1e9, 2),
+    }
+
+
+def exp_pallas_gather(R: int, W: int = 128):
+    """Feasibility + speed of a Pallas TPU kernel doing vectorized dynamic
+    gather from a VMEM-resident [lc+1, W] table (the hand-rolled multi-root
+    ELL-SpMV core; Mosaic supports 2D gather via jnp.take axis=0)."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    E, n, nnz = upload_ell()
+    lc = E.local_cols
+    # use the biggest mid-size bucket's indices as the workload
+    bc = max((b[0][0, 0] for b in E.buckets), key=lambda a: a.size)
+    nb, kb = bc.shape
+    idx = jnp.minimum(bc, lc).reshape(-1)  # [nb*kb]
+    m = idx.shape[0]
+    TILE = 65536
+    m_pad = -(-m // TILE) * TILE
+    idx = jnp.concatenate([idx, jnp.zeros((m_pad - m,), jnp.int32)])
+
+    def kernel(x_ref, idx_ref, o_ref):
+        # Mosaic 2D gather: per-lane gather along sublanes —
+        # g[e, r] = x[idx[e], r] via take_along_axis with broadcast idx.
+        idx2 = jnp.broadcast_to(idx_ref[:][:, None], (TILE, W))
+        g = jnp.take_along_axis(x_ref[:], idx2, axis=0)  # [TILE, W]
+        o_ref[:] = jnp.max(g.reshape(-1, 8, g.shape[1]), axis=0)
+
+    @jax.jit
+    def run(x):
+        def body(_, carry):
+            x = carry
+            out = pl.pallas_call(
+                kernel,
+                grid=(m_pad // TILE,),
+                in_specs=[
+                    pl.BlockSpec(memory_space=pltpu.VMEM),
+                    pl.BlockSpec((TILE,), lambda i: (i,)),
+                ],
+                out_specs=pl.BlockSpec((8, W), lambda i: (i, 0)),
+                out_shape=jax.ShapeDtypeStruct(
+                    (m_pad // TILE * 8, W), jnp.int32
+                ),
+            )(x, idx)
+            return x.at[0, 0].set(jnp.max(out))
+
+        return lax.fori_loop(0, R, body, x)
+
+    x0 = jnp.tile(jnp.arange(lc + 1, dtype=jnp.int32)[:, None], (1, W))
+    out = run(x0)
+    jax.block_until_ready(out)
+    time.sleep(3.0)
+    dt = timed(lambda prev: run(x0 if prev is None else prev), 1,
+               lambda out: int(jax.device_get(out[0, 0])))
+    return {
+        "experiment": f"pallas_gather R={R} W={W}",
+        "iters": R,
+        "dt_s": round(dt, 4),
+        "ms_per_iter": round(dt / R * 1e3, 3),
+        "gather_slots": int(m),
+        "Mindex_per_s": round(m * R / dt / 1e6, 1),
+    }
+
 
 if __name__ == "__main__":
     main()

@@ -279,3 +279,90 @@ def validate_bfs_tree(A_dense, source, parents, levels) -> list[str]:
     if disc != seen:
         errs.append(f"discovered {len(disc)} != reachable {len(seen)}")
     return errs
+
+
+@partial(jax.jit, static_argnames=("max_iters", "sr"))
+def bfs_batch(
+    A,
+    sources,
+    max_iters: int | None = None,
+    sr: "Semiring" = SELECT2ND_MAX,
+):
+    """Multi-source batched BFS: W independent BFS trees in ONE program.
+
+    Graph500 runs 64 search keys (the reference loops them host-side,
+    ``TopDownBFS.cpp:437-444``); on TPU the whole batch advances together as
+    a [n, W] frontier matrix — SURVEY §2.3 strategy 7 (BetwCent's
+    frontier-as-matrix) applied to BFS itself. Two wins, both measured on
+    v5e: (a) gathers are per-index bound, so W parent lanes ride one index
+    fetch ~free; (b) the whole batch is one launch — one fixed ~100ms
+    dispatch instead of W of them.
+
+    ``sources``: int32 [W]. Returns (parents DistMultiVec [n, W] row-aligned,
+    levels DistMultiVec, num_iters) — num_iters is the MAX level over the
+    batch (lanes that finish early idle through the remaining levels with
+    no semantic effect; dense-regime level cost is frontier-independent).
+    """
+    from ..parallel.vec import DistMultiVec
+    from ..parallel.ellmat import EllParMat, dist_spmv_ell_masked_multi
+
+    grid = A.grid
+    n = A.nrows
+    pr_, lr = grid.pr, grid.local_rows(n)
+    pc_, lc = grid.pc, grid.local_cols(A.ncols)
+    W = sources.shape[0]
+    iters = max_iters if max_iters is not None else n
+
+    row_gids = _global_ids(grid, pr_, lr, n, "row")  # [pr, lr]
+    col_gids = _global_ids(grid, pc_, lc, A.ncols, "col")
+
+    src = sources.astype(jnp.int32)[None, None, :]  # [1, 1, W]
+    parents0 = jnp.where(
+        row_gids[:, :, None] == src, src, jnp.int32(-1)
+    )  # [pr, lr, W]
+    levels0 = jnp.where(row_gids[:, :, None] == src, 0, -1).astype(jnp.int32)
+    x0 = jnp.where(col_gids[:, :, None] == src, src, jnp.int32(-1))
+
+    def mk(b, align):
+        return DistMultiVec(blocks=b, length=n, align=align, grid=grid)
+
+    def cond(state):
+        _, _, _, level, active = state
+        return active & (level < iters)
+
+    def step(state):
+        parents, levels, x, level, _ = state
+        unvisited = mk(parents < 0, "row")
+        y = dist_spmv_ell_masked_multi(sr, A, mk(x, "col"), unvisited)
+        new = (y.blocks >= 0) & (parents < 0) & (row_gids[:, :, None] >= 0)
+        parents = jnp.where(new, y.blocks, parents)
+        levels = jnp.where(new, level + 1, levels)
+        x_next = mk(
+            jnp.where(new, row_gids[:, :, None], -1), "row"
+        ).realign("col").blocks
+        active = jnp.any(new)
+        return parents, levels, x_next, level + 1, active
+
+    parents, levels, _, niter, _ = jax.lax.while_loop(
+        cond, step, (parents0, levels0, x0, jnp.int32(0), jnp.bool_(True))
+    )
+    return mk(parents, "row"), mk(levels, "row"), niter
+
+
+@jax.jit
+def batch_traversed_edges(deg_row_blocks, parents) -> jax.Array:
+    """Graph500 kernel-2 edge count per root, ON DEVICE: [W] int array of
+    (sum of degrees over discovered vertices) / 2 — so the benchmark's only
+    D2H readback is one tiny vector AFTER the timed batch.
+
+    ``deg_row_blocks``: [pr, lr] structural out-degrees (row-aligned,
+    padding 0); ``parents``: the DistMultiVec from ``bfs_batch``.
+    """
+    disc = parents.blocks >= 0  # [pr, lr, W]
+    # int32 accumulation: per-root traversed edges <= nnz, which stays below
+    # 2^31 through scale 26 at edgefactor 16 — the single-chip regime.
+    te = jnp.sum(
+        jnp.where(disc, deg_row_blocks[:, :, None], 0).astype(jnp.int32),
+        axis=(0, 1),
+    )
+    return te // 2

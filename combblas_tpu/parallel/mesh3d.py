@@ -233,6 +233,19 @@ class SpParMat3D:
         np.add.at(out, (r, c), v)
         return out
 
+    # --- 2D <-> 3D conversions (on-device; see module-level functions) ------
+
+    @staticmethod
+    def from_spmat(
+        A, grid3: "Grid3D", split: str = "col", **kw
+    ) -> "SpParMat3D":
+        """2D SpParMat → 3D (≈ ``SpParMat3D(SpParMat&)``)."""
+        return spmat3d_from_spmat(A, grid3, split, **kw)
+
+    def to_spmat(self, grid2, **kw):
+        """3D → 2D SpParMat (≈ the layermat readback conversion)."""
+        return spmat_from_spmat3d(self, grid2, **kw)
+
     def shrink_to_fit(self, pow2: bool = True) -> "SpParMat3D":
         """Host helper: truncate slot capacity to the max tile nnz (pieces
         from ``col_split`` are front-compacted, so slicing is safe)."""
@@ -567,4 +580,241 @@ def spgemm3d(
         flop_capacity=rnd(flop_cap),
         out_capacity=min(rnd(out_cap), max(dense_tile, 1)),
         piece_capacity=rnd(piece_cap),
+    )
+
+
+# --- 2D <-> 3D conversions (≈ SpParMat3D(SpParMat&) / layermat readback,
+# SpParMat3D.cpp:74-145, 197-320) ------------------------------------------
+
+
+def _globalize2d(A):
+    """2D tile arrays → global-id arrays [pr, pc, cap] (no communication:
+    adds tile offsets on the sharded arrays in place; padding → nrows/ncols
+    sentinels)."""
+    from .spmat import SpParMat  # noqa: F401 (type context)
+
+    g = A.grid
+    lr, lc = A.local_rows, A.local_cols
+    valid = A.rows < lr
+    ioff = jnp.arange(g.pr, dtype=jnp.int32)[:, None, None]
+    joff = jnp.arange(g.pc, dtype=jnp.int32)[None, :, None]
+    gr = jnp.where(valid, A.rows + ioff * lr, A.nrows)
+    gc = jnp.where(valid, A.cols + joff * lc, A.ncols)
+    return gr.astype(jnp.int32), gc.astype(jnp.int32), A.vals
+
+
+def _globalize3d(A3: SpParMat3D):
+    """3D tile arrays → global-id arrays [L, pr, pc, cap] (split-aware)."""
+    g = A3.grid
+    L = g.layers
+    lr, lc = g.local_rows(A3.nrows), g.local_cols(A3.ncols)
+    tr, tc = A3.tile_rows, A3.tile_cols
+    valid = A3.rows < tr
+    loff = jnp.arange(L, dtype=jnp.int32)[:, None, None, None]
+    ioff = jnp.arange(g.pr, dtype=jnp.int32)[None, :, None, None]
+    joff = jnp.arange(g.pc, dtype=jnp.int32)[None, None, :, None]
+    if A3.split == "col":
+        gr = A3.rows + ioff * lr
+        gc = A3.cols + joff * lc + loff * tc
+    else:
+        gr = A3.rows + ioff * lr + loff * tr
+        gc = A3.cols + joff * lc
+    gr = jnp.where(valid, gr, A3.nrows)
+    gc = jnp.where(valid, gc, A3.ncols)
+    return gr.astype(jnp.int32), gc.astype(jnp.int32), A3.vals
+
+
+@partial(
+    jax.jit,
+    static_argnames=("grid", "nrows", "ncols", "split", "stage_capacity",
+                     "tile_capacity"),
+)
+def redistribute_coo3d(
+    grid: Grid3D,
+    rows: Array,
+    cols: Array,
+    vals: Array,
+    nrows: int,
+    ncols: int,
+    *,
+    split: str,
+    stage_capacity: int,
+    tile_capacity: int,
+):
+    """Route device-resident GLOBAL tuples to their 3D owner tiles.
+
+    rows/cols/vals: [L, pr, pc, chunk] arbitrary global tuples per device
+    (invalid slots: row >= nrows). Three fixed-capacity all_to_all hops —
+    by owner column over "c", owner row over "r", owner layer over "l" —
+    the dimension-ordered extension of ``redistribute_coo``'s 2D routing
+    (the fiber Alltoallv of the reference's 2D→3D conversion,
+    SpParMat3D.cpp:74-145). Returns (SpParMat3D, dropped count).
+    """
+    from .redistribute import _bucket_route
+
+    L = grid.layers
+    lr, lc = grid.local_rows(nrows), grid.local_cols(ncols)
+    split_dim = lc if split == "col" else lr
+    if split_dim % L:
+        raise ValueError(
+            f"3D {split}-split needs the local {'column' if split == 'col' else 'row'} "
+            f"count ({split_dim}) to divide evenly over {L} layers; pad the "
+            f"matrix dims or choose a different grid"
+        )
+    w = split_dim // L
+    tr = lr if split == "col" else w
+    tc = w if split == "col" else lc
+    pr_, pc_ = grid.pr, grid.pc
+
+    def hop(r, c, v, dest, ndest, axis):
+        br, bc, bv, drop = _bucket_route(
+            dest.astype(jnp.int32), r, c, v, ndest, stage_capacity,
+            jnp.int32(nrows), jnp.int32(ncols),
+        )
+        br = lax.all_to_all(br, axis, split_axis=0, concat_axis=0)
+        bc = lax.all_to_all(bc, axis, split_axis=0, concat_axis=0)
+        bv = lax.all_to_all(bv, axis, split_axis=0, concat_axis=0)
+        return br.reshape(-1), bc.reshape(-1), bv.reshape(-1), drop
+
+    def body(r, c, v):
+        r0, c0, v0 = r[0, 0, 0], c[0, 0, 0], v[0, 0, 0]
+        valid = r0 < nrows
+        oj = jnp.where(valid, c0 // lc, pc_)
+        r1, c1, v1, d1 = hop(r0, c0, v0, oj, pc_, COL_AXIS)
+        valid = r1 < nrows
+        oi = jnp.where(valid, r1 // lr, pr_)
+        r2, c2, v2, d2 = hop(r1, c1, v1, oi, pr_, ROW_AXIS)
+        valid = r2 < nrows
+        if split == "col":
+            ol = jnp.where(valid, (c2 % lc) // w, L)
+        else:
+            ol = jnp.where(valid, (r2 % lr) // w, L)
+        r3, c3, v3, d3 = hop(r2, c2, v2, ol, L, LAYER_AXIS)
+        # localize
+        i = lax.axis_index(ROW_AXIS)
+        j = lax.axis_index(COL_AXIS)
+        ok = r3 < nrows
+        if split == "col":
+            lrow = jnp.where(ok, r3 - i * lr, tr)
+            lcol = jnp.where(ok, (c3 - j * lc) % w, tc)
+        else:
+            lrow = jnp.where(ok, (r3 - i * lr) % w, tr)
+            lcol = jnp.where(ok, c3 - j * lc, tc)
+        nvalid = jnp.sum(ok).astype(jnp.int32)
+        drop4 = jnp.maximum(nvalid - tile_capacity, 0)
+        t = SpTuples(
+            rows=lrow.astype(jnp.int32), cols=lcol.astype(jnp.int32),
+            vals=jnp.where(ok, v3, 0), nnz=nvalid, nrows=tr, ncols=tc,
+        )._select(ok).with_capacity(tile_capacity)
+        dropped = lax.psum(
+            lax.psum(lax.psum(d1 + d2 + d3 + drop4, ROW_AXIS), COL_AXIS),
+            LAYER_AXIS,
+        )
+        return (
+            t.rows[None, None, None], t.cols[None, None, None],
+            t.vals[None, None, None], t.nnz[None, None, None],
+            dropped[None, None, None],
+        )
+
+    r, c, v, n, dropped = jax.shard_map(
+        body,
+        mesh=grid.mesh,
+        in_specs=(TILE3_SPEC,) * 3,
+        out_specs=(TILE3_SPEC,) * 5,
+        check_vma=False,
+    )(rows, cols, vals)
+    mat = SpParMat3D(
+        rows=r, cols=c, vals=v, nnz=n, nrows=int(nrows), ncols=int(ncols),
+        split=split, grid=grid,
+    )
+    return mat, dropped[0, 0, 0]
+
+
+def _route_with_retry(route, chunk_cap: int, dest_fanouts, total: int,
+                      ndev: int, slack: float, max_retries: int, what: str):
+    """Shared conversion driver: size stage/tile capacities from the chunk
+    shape and total nnz, route, and double capacities on dropped tuples."""
+    per_dest = max(-(-chunk_cap // f) for f in dest_fanouts)
+    stage_cap = 1 << max(int(np.ceil(np.log2(max(per_dest * slack, 1)))), 0)
+    tile_cap = 1 << max(
+        int(np.ceil(np.log2(max(total / ndev * slack, 1)))), 0
+    )
+    nd = 0
+    for _ in range(max_retries + 1):
+        mat, dropped = route(stage_cap, tile_cap)
+        nd = int(dropped)
+        if nd == 0:
+            return mat
+        stage_cap *= 2
+        tile_cap *= 2
+    raise ValueError(
+        f"{what} dropped {nd} tuples after {max_retries} capacity doublings"
+    )
+
+
+def spmat3d_from_spmat(
+    A, grid3: Grid3D, split: str = "col", *, slack: float = 2.0,
+    max_retries: int = 3,
+) -> SpParMat3D:
+    """2D → 3D conversion (≈ ``SpParMat3D(SpParMat&)``,
+    SpParMat3D.cpp:74-145), fully on device.
+
+    Globalizes the 2D tiles in place (no comm), reshards the tuple chunks
+    onto the 3D mesh (XLA moves bytes over ICI at the jit boundary), then
+    routes with ``redistribute_coo3d``. The source 2D grid may have any
+    shape with pr*pc == layers*pr3*pc3 (routing is by global id — no nested
+    process-grid restriction), but the 3D grid's local split dimension must
+    divide evenly over the layers (ValueError otherwise).
+    """
+    assert A.grid.size == grid3.layers * grid3.pr * grid3.pc, (
+        "device count mismatch between 2D grid and 3D grid"
+    )
+    gr, gc, gv = _globalize2d(A)
+    cap = gr.shape[-1]
+    sh3 = grid3.tile_sharding()
+    shape3 = (grid3.layers, grid3.pr, grid3.pc, cap)
+    gr3 = jax.device_put(gr.reshape(shape3), sh3)
+    gc3 = jax.device_put(gc.reshape(shape3), sh3)
+    gv3 = jax.device_put(gv.reshape(shape3), sh3)
+    total = int(np.asarray(jnp.sum(A.nnz)))
+
+    def route(stage_cap, tile_cap):
+        return redistribute_coo3d(
+            grid3, gr3, gc3, gv3, A.nrows, A.ncols, split=split,
+            stage_capacity=stage_cap, tile_capacity=tile_cap,
+        )
+
+    return _route_with_retry(
+        route, cap, (grid3.pc, grid3.pr, grid3.layers), total, A.grid.size,
+        slack, max_retries, "2D→3D conversion",
+    )
+
+
+def spmat_from_spmat3d(
+    A3: SpParMat3D, grid2, *, slack: float = 2.0, max_retries: int = 3,
+):
+    """3D → 2D conversion (the layermat readback direction,
+    SpParMat3D.cpp:197-320), fully on device: globalize, reshard chunks to
+    the 2D mesh, route with the 2D ``redistribute_coo``."""
+    from .redistribute import redistribute_coo
+
+    assert grid2.size == A3.grid.layers * A3.grid.pr * A3.grid.pc
+    gr, gc, gv = _globalize3d(A3)
+    cap = gr.shape[-1]
+    sh2 = grid2.tile_sharding()
+    shape2 = (grid2.pr, grid2.pc, cap)
+    gr2 = jax.device_put(gr.reshape(shape2), sh2)
+    gc2 = jax.device_put(gc.reshape(shape2), sh2)
+    gv2 = jax.device_put(gv.reshape(shape2), sh2)
+    total = int(np.asarray(jnp.sum(A3.nnz)))
+
+    def route(stage_cap, tile_cap):
+        return redistribute_coo(
+            grid2, gr2, gc2, gv2, A3.nrows, A3.ncols,
+            stage_capacity=stage_cap, tile_capacity=tile_cap,
+        )
+
+    return _route_with_retry(
+        route, cap, (grid2.pc, grid2.pr), total, grid2.size,
+        slack, max_retries, "3D→2D conversion",
     )

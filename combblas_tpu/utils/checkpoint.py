@@ -71,7 +71,7 @@ def save(path: str, obj) -> None:
     )
 
 
-def load(path: str, grid: Grid):
+def load(path: str, grid: Grid, fill=None):
     """Load a .npz checkpoint onto ``grid``.
 
     Same grid shape → direct device_put of the tile arrays. Different
@@ -96,11 +96,12 @@ def load(path: str, grid: Grid):
                 grid, rows, cols, vals, meta["nrows"], meta["ncols"]
             )
         if meta["kind"] == "DistVec":
-            return _restore_vec(np.asarray(z["blocks"]), meta, grid)
+            return _restore_vec(np.asarray(z["blocks"]), meta, grid, fill)
         raise TypeError(meta["kind"])
 
 
-def _restore_vec(blocks: np.ndarray, meta: dict, grid: Grid) -> DistVec:
+def _restore_vec(blocks: np.ndarray, meta: dict, grid: Grid,
+                 fill_override=None) -> DistVec:
     """Rebuild a DistVec preserving padding fill values.
 
     Matching grid shape → the saved padded blocks are device_put verbatim
@@ -125,10 +126,23 @@ def _restore_vec(blocks: np.ndarray, meta: dict, grid: Grid) -> DistVec:
             length=meta["length"], align=meta["align"], grid=grid,
         )
     flat = blocks.reshape(-1)[: meta["length"]]
-    fill = meta.get("fill")
+    fill = meta.get("fill", fill_override)
+    if fill_override is not None:
+        fill = fill_override
+    if fill is None:
+        import warnings
+
+        warnings.warn(
+            "cross-grid checkpoint restore: the saved vector had no padding "
+            "slot to record its fill value; padding with 0. If the vector "
+            "was built with a non-zero fill (e.g. -1 parents), pass "
+            "fill=... to load()/load_orbax.",
+            stacklevel=3,
+        )
+        fill = 0
     return DistVec.from_global(
         grid, flat, align=meta["align"],
-        fill=np.asarray(fill, dtype=blocks.dtype) if fill is not None else 0,
+        fill=np.asarray(fill, dtype=blocks.dtype),
     )
 
 
@@ -173,7 +187,7 @@ def save_orbax(path: str, obj) -> None:
         json.dump(meta, f)
 
 
-def load_orbax(path: str, grid: Grid):
+def load_orbax(path: str, grid: Grid, fill=None):
     import orbax.checkpoint as ocp
 
     path = os.path.abspath(path)
@@ -195,5 +209,5 @@ def load_orbax(path: str, grid: Grid):
             nrows=meta["nrows"], ncols=meta["ncols"], grid=grid,
         )
     if meta["kind"] == "DistVec":
-        return _restore_vec(np.asarray(state["blocks"]), meta, grid)
+        return _restore_vec(np.asarray(state["blocks"]), meta, grid, fill)
     raise TypeError(meta["kind"])

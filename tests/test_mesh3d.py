@@ -100,3 +100,44 @@ def test_summa3d_square(rng):
     B = SpParMat3D.from_global_coo(grid, r, c, d[r, c], 16, 16, "row")
     C = spgemm3d(PLUS_TIMES, A, B)
     np.testing.assert_allclose(C.to_dense(), d @ d, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("split", ["col", "row"])
+@pytest.mark.parametrize("shape2", [(2, 4), (4, 2)])
+def test_2d_3d_conversion_roundtrip(rng, split, shape2):
+    """On-device 2D→3D→2D conversion preserves the matrix exactly
+    (≈ SpParMat3D(SpParMat&) + readback, SpParMat3D.cpp:74-145,197-320)."""
+    from combblas_tpu.parallel.grid import Grid
+    from combblas_tpu.parallel.mesh3d import Grid3D, SpParMat3D
+    from combblas_tpu.parallel.spmat import SpParMat
+
+    g2 = Grid.make(*shape2)
+    g3 = Grid3D.make(2, 2, 2)
+    n = 48
+    d = random_dense(rng, n, n, 0.15)
+    A = SpParMat.from_dense(g2, d)
+    A3 = SpParMat3D.from_spmat(A, g3, split=split)
+    assert A3.split == split
+    np.testing.assert_allclose(A3.to_dense(), d)
+    back = A3.to_spmat(g2)
+    np.testing.assert_allclose(back.to_dense(), d)
+    assert int(np.asarray(back.getnnz())) == int((d != 0).sum())
+
+
+def test_3d_conversion_then_spgemm(rng):
+    """Converted matrices are first-class: SUMMA3D on a converted pair
+    matches the dense product (the SpGEMM3DTest pattern,
+    ReleaseTests/CMakeLists.txt:43)."""
+    from combblas_tpu.parallel.grid import Grid
+    from combblas_tpu.parallel.mesh3d import Grid3D, SpParMat3D, spgemm3d
+    from combblas_tpu.parallel.spmat import SpParMat
+
+    g2 = Grid.make(2, 4)
+    g3 = Grid3D.make(2, 2, 2)
+    n = 32
+    d = random_dense(rng, n, n, 0.2)
+    A = SpParMat.from_dense(g2, d)
+    A3 = SpParMat3D.from_spmat(A, g3, split="col")
+    B3 = SpParMat3D.from_spmat(A, g3, split="row")
+    C3 = spgemm3d(PLUS_TIMES, A3, B3)
+    np.testing.assert_allclose(C3.to_dense(), d @ d, rtol=1e-5, atol=1e-6)
