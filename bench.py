@@ -23,6 +23,10 @@ benchmarks/results/instrument_r2_raw*.txt):
     per-index cost is split 64 ways;
   * kernel-2 TEPS accounting runs on device (batch_traversed_edges); the
     only D2H is one [W] vector + the sync scalar, AFTER timing.
+Operating point (measured sweep, benchmarks/results/bench_sweep_r2*.txt):
+scale 20 x 256 roots = 217.8 MTEPS; W=384+ exceeds the 16G HBM at scale 20,
+W=512 at scale 19 also OOMs; scale 21 x 256 OOMs. Round-1 single-root
+per-launch design measured 3.32 MTEPS — this is 65x.
 
 AXON D2H NOTE: this chip's runtime permanently degrades launch performance
 (~1000x) after ANY device->host readback, so the pipeline is strictly
@@ -40,9 +44,9 @@ import json
 import os
 import time
 
-SCALE = int(os.environ.get("BENCH_SCALE", "19"))
+SCALE = int(os.environ.get("BENCH_SCALE", "20"))
 EDGEFACTOR = int(os.environ.get("BENCH_EDGEFACTOR", "16"))
-NROOTS = int(os.environ.get("BENCH_NROOTS", "64"))
+NROOTS = int(os.environ.get("BENCH_NROOTS", "256"))
 BASELINE_MTEPS = 1636.0  # Hopper 1024 cores, R-MAT "mini"
 
 
@@ -85,13 +89,13 @@ def main():
     # reliable barrier through the tunnel, so sleep covers the drain and the
     # timed section is closed by the te readback (its ~5 ms inflates dt,
     # biasing reported TEPS DOWN).
-    p, _, _ = bfs_batch(E, roots_dev)
+    p, _, _ = bfs_batch(E, roots_dev, track_levels=False)
     te_dev = batch_traversed_edges(deg_blocks, p)
     jax.block_until_ready(te_dev)
     time.sleep(5.0)
 
     t0 = time.perf_counter()
-    parents, _, _ = bfs_batch(E, roots_dev)
+    parents, _, _ = bfs_batch(E, roots_dev, track_levels=False)
     te_dev = batch_traversed_edges(deg_blocks, parents)
     te = np.asarray(jax.device_get(te_dev))  # true barrier
     dt_total = time.perf_counter() - t0

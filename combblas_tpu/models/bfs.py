@@ -281,12 +281,13 @@ def validate_bfs_tree(A_dense, source, parents, levels) -> list[str]:
     return errs
 
 
-@partial(jax.jit, static_argnames=("max_iters", "sr"))
+@partial(jax.jit, static_argnames=("max_iters", "sr", "track_levels"))
 def bfs_batch(
     A,
     sources,
     max_iters: int | None = None,
     sr: "Semiring" = SELECT2ND_MAX,
+    track_levels: bool = True,
 ):
     """Multi-source batched BFS: W independent BFS trees in ONE program.
 
@@ -302,6 +303,9 @@ def bfs_batch(
     levels DistMultiVec, num_iters) — num_iters is the MAX level over the
     batch (lanes that finish early idle through the remaining levels with
     no semantic effect; dense-regime level cost is frontier-independent).
+    ``track_levels=False`` drops the level array from the loop carry
+    (saves one [n, W] buffer — the difference between fitting W=512 in HBM
+    or not for benchmarking; levels are then returned as parents' sign).
     """
     from ..parallel.vec import DistMultiVec
     from ..parallel.ellmat import EllParMat, dist_spmv_ell_masked_multi
@@ -320,7 +324,11 @@ def bfs_batch(
     parents0 = jnp.where(
         row_gids[:, :, None] == src, src, jnp.int32(-1)
     )  # [pr, lr, W]
-    levels0 = jnp.where(row_gids[:, :, None] == src, 0, -1).astype(jnp.int32)
+    levels0 = (
+        jnp.where(row_gids[:, :, None] == src, 0, -1).astype(jnp.int32)
+        if track_levels
+        else jnp.zeros((1, 1, 1), jnp.int32)  # placeholder carry
+    )
     x0 = jnp.where(col_gids[:, :, None] == src, src, jnp.int32(-1))
 
     def mk(b, align):
@@ -336,7 +344,8 @@ def bfs_batch(
         y = dist_spmv_ell_masked_multi(sr, A, mk(x, "col"), unvisited)
         new = (y.blocks >= 0) & (parents < 0) & (row_gids[:, :, None] >= 0)
         parents = jnp.where(new, y.blocks, parents)
-        levels = jnp.where(new, level + 1, levels)
+        if track_levels:
+            levels = jnp.where(new, level + 1, levels)
         x_next = mk(
             jnp.where(new, row_gids[:, :, None], -1), "row"
         ).realign("col").blocks
@@ -346,6 +355,10 @@ def bfs_batch(
     parents, levels, _, niter, _ = jax.lax.while_loop(
         cond, step, (parents0, levels0, x0, jnp.int32(0), jnp.bool_(True))
     )
+    if not track_levels:
+        # levels were not tracked: return discovery indicator (0 for the
+        # sources / discovered? -1 undiscovered) — parents' sign carries it.
+        levels = jnp.where(parents >= 0, 0, -1)
     return mk(parents, "row"), mk(levels, "row"), niter
 
 

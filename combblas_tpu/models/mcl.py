@@ -156,11 +156,22 @@ def mcl(
     recover_pct: float = 0.9,
     hard_threshold: float = 1e-4,
     add_self_loops: bool = True,
+    layers: int = 1,
+    grid3=None,
 ) -> tuple[DistVec, int, float]:
     """Markov clustering. Returns (cluster labels, iterations, final chaos).
 
     ``phases > 1`` requires n % (grid.pc * phases) == 0 (the local column
     split); otherwise expansion falls back to unphased with a warning.
+
+    ``layers > 1`` runs the communication-avoiding 3D expansion path
+    (HipMCL's production configuration, MCL.cpp:574-588 with layers>1):
+    the matrix converts on-device to a col-split ``SpParMat3D`` on a
+    layers × pr × pc grid (``grid3`` overrides the default square
+    factorization), every iteration resplits a row-split copy, expands with
+    ``mem_efficient_spgemm3d`` + the 3D prune/recover/select hook, and
+    stochasticization/chaos/inflation run as per-layer column ops. The
+    converged matrix converts back to 2D for cluster interpretation.
 
     Reference driver: ``HipMCL`` (MCL.cpp:515-660); defaults mirror
     ``InitParam`` (MCL.cpp:144-150: prunelimit 1e-4, select 1100, recover
@@ -179,23 +190,147 @@ def mcl(
         A = A.add_loops(jnp.asarray(1, A.dtype))
     A = make_col_stochastic(A)
 
-    def prune_fn(C):
-        return mcl_prune_recovery_select(
-            C, hard_threshold, select_num, recover_num, recover_pct
+    if layers > 1:
+        if grid3 is None:
+            import math
+
+            from ..parallel.mesh3d import Grid3D
+
+            p2 = A.grid.size // layers
+            p3 = int(math.isqrt(p2))
+            assert layers * p3 * p3 == A.grid.size, (
+                f"cannot factor {A.grid.size} devices into "
+                f"{layers} layers x square grid; pass grid3= explicitly"
+            )
+            grid3 = Grid3D.make(layers, p3, p3)
+        A, it, ch = _mcl3d_loop(
+            A, grid3, inflation, eps, max_iters, phases,
+            dict(
+                hard_threshold=hard_threshold, select_num=select_num,
+                recover_num=recover_num, recover_pct=recover_pct,
+            ),
         )
+    else:
+
+        def prune_fn(C):
+            return mcl_prune_recovery_select(
+                C, hard_threshold, select_num, recover_num, recover_pct
+            )
+
+        ch = float("inf")
+        it = 0
+        for it in range(1, max_iters + 1):
+            A = mem_efficient_spgemm(
+                PLUS_TIMES, A, A, phases, prune_fn=prune_fn
+            )
+            A = make_col_stochastic(A)
+            ch = float(chaos(A))
+            A = inflate(A, inflation)
+            if ch < eps:
+                break
+
+        if hard_threshold > 0:  # drop float32 residue before interpretation
+            A = A.prune(_lt_pred(float(hard_threshold)))
+    sym = A.ewise_add(A.transpose(), PLUS_TIMES)
+    labels, _ = connected_components(sym)
+    return labels, it, ch
+
+
+# --- 3D (communication-avoiding) MCL path (≈ HipMCL layers>1) --------------
+#
+# The reference's flagship production configuration: expansion runs
+# MemEfficientSpGEMM3D on a layered grid (MCL.cpp:574-588 with layers>1,
+# ParFriends.h:3215-3712); pruning/inflation happen on the 3D matrix via
+# per-layer column ops. Here the 3D column ops (mesh3d.reduce3d_cols /
+# kselect3d / prune_column3d) run on the 3-axis mesh directly — "r"-axis
+# collectives act within each layer automatically.
+
+
+def make_col_stochastic3d(A3):
+    from ..parallel.mesh3d import dim_apply3d_cols, reduce3d_cols
+
+    sums = reduce3d_cols(PLUS_TIMES, A3)
+    return dim_apply3d_cols(A3, sums, _stochastic_scale)
+
+
+def chaos3d(A3) -> jnp.ndarray:
+    from ..parallel.mesh3d import nnz_per_column3d, reduce3d_cols
+
+    colmax = reduce3d_cols(MAX_MIN, A3)
+    colssq = reduce3d_cols(PLUS_TIMES, A3, map_fn=_square)
+    nnzc = nnz_per_column3d(A3)
+    diff = colmax - colssq
+    scaled = jnp.where(nnzc > 0, diff * nnzc.astype(diff.dtype), 0)
+    return jnp.max(scaled)
+
+
+def inflate3d(A3, power: float):
+    from ..parallel.mesh3d import apply3d
+
+    return make_col_stochastic3d(apply3d(A3, _pow_fn(power)))
+
+
+def mcl_prune_recovery_select3d(
+    C3,
+    hard_threshold: float = 1e-8,
+    select_num: int = 1100,
+    recover_num: int = 1400,
+    recover_pct: float = 0.9,
+):
+    """3D twin of ``mcl_prune_recovery_select`` (the MemEfficientSpGEMM3D
+    prune hook, ParFriends.h:3215-3712 + MCLPruneRecoverySelect)."""
+    from ..parallel.mesh3d import (
+        kselect3d,
+        prune3d,
+        prune_column3d,
+        reduce3d_cols,
+    )
+
+    if hard_threshold > 0:
+        C3 = prune3d(C3, _lt_pred(float(hard_threshold)))
+    s_th = kselect3d(C3, select_num)
+    pruned = prune_column3d(C3, s_th, keep=_keep_ge)
+    kept = reduce3d_cols(PLUS_TIMES, pruned)
+    orig = reduce3d_cols(PLUS_TIMES, C3)
+    need_recover = kept < recover_pct * orig
+    if not bool(jnp.any(need_recover)):
+        return pruned
+    r_th = kselect3d(C3, recover_num)
+    final = jnp.where(need_recover, jnp.minimum(r_th, s_th), s_th)
+    return prune_column3d(C3, final, keep=_keep_ge)
+
+
+def _mcl3d_loop(
+    A: SpParMat, grid3, inflation, eps, max_iters, phases, prune_kwargs
+):
+    """The 3D expansion loop: returns (converged 2D matrix, iters, chaos)."""
+    from ..parallel.mesh3d import (
+        SpParMat3D,
+        mem_efficient_spgemm3d,
+        prune3d,
+        resplit3d,
+    )
+
+    A3 = SpParMat3D.from_spmat(A, grid3, split="col")
+
+    def prune_fn(C3):
+        return mcl_prune_recovery_select3d(C3, **prune_kwargs)
 
     ch = float("inf")
     it = 0
     for it in range(1, max_iters + 1):
-        A = mem_efficient_spgemm(PLUS_TIMES, A, A, phases, prune_fn=prune_fn)
-        A = make_col_stochastic(A)
-        ch = float(chaos(A))
-        A = inflate(A, inflation)
+        B3 = resplit3d(A3, "row").shrink_to_fit()
+        C3 = mem_efficient_spgemm3d(
+            PLUS_TIMES, A3, B3, phases, prune_fn=prune_fn
+        )
+        C3 = make_col_stochastic3d(C3)
+        ch = float(chaos3d(C3))
+        A3 = inflate3d(C3, inflation)
+        A3 = A3.shrink_to_fit()
         if ch < eps:
             break
 
-    if hard_threshold > 0:  # drop float32 residue before reading clusters
-        A = A.prune(_lt_pred(float(hard_threshold)))
-    sym = A.ewise_add(A.transpose(), PLUS_TIMES)
-    labels, _ = connected_components(sym)
-    return labels, it, ch
+    ht = prune_kwargs.get("hard_threshold", 0)
+    if ht > 0:  # float32 residue, as in the 2D path
+        A3 = prune3d(A3, _lt_pred(float(ht)))
+    return A3.to_spmat(A.grid), it, ch

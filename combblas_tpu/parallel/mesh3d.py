@@ -752,6 +752,20 @@ def _route_with_retry(route, chunk_cap: int, dest_fanouts, total: int,
     )
 
 
+def _rechunk(arr, ndev: int, sentinel):
+    """Flatten tuple chunks and re-split over ``ndev`` devices, padding the
+    tail with ``sentinel`` (an invalid row id — dropped by routing). Lets
+    conversions change device count (a 2D square grid is never layers*p^2)."""
+    flat = arr.reshape(-1)
+    chunk = -(-flat.shape[0] // ndev)
+    pad = ndev * chunk - flat.shape[0]
+    if pad:
+        flat = jnp.concatenate(
+            [flat, jnp.full((pad,), sentinel, flat.dtype)]
+        )
+    return flat, chunk
+
+
 def spmat3d_from_spmat(
     A, grid3: Grid3D, split: str = "col", *, slack: float = 2.0,
     max_retries: int = 3,
@@ -761,21 +775,21 @@ def spmat3d_from_spmat(
 
     Globalizes the 2D tiles in place (no comm), reshards the tuple chunks
     onto the 3D mesh (XLA moves bytes over ICI at the jit boundary), then
-    routes with ``redistribute_coo3d``. The source 2D grid may have any
-    shape with pr*pc == layers*pr3*pc3 (routing is by global id — no nested
+    routes with ``redistribute_coo3d``. The source 2D grid may have ANY
+    shape and device count (routing is by global id — no nested
     process-grid restriction), but the 3D grid's local split dimension must
     divide evenly over the layers (ValueError otherwise).
     """
-    assert A.grid.size == grid3.layers * grid3.pr * grid3.pc, (
-        "device count mismatch between 2D grid and 3D grid"
-    )
+    ndev3 = grid3.layers * grid3.pr * grid3.pc
     gr, gc, gv = _globalize2d(A)
-    cap = gr.shape[-1]
+    grf, cap = _rechunk(gr, ndev3, jnp.int32(A.nrows))
+    gcf, _ = _rechunk(gc, ndev3, jnp.int32(A.ncols))
+    gvf, _ = _rechunk(gv, ndev3, jnp.zeros((), gv.dtype))
     sh3 = grid3.tile_sharding()
     shape3 = (grid3.layers, grid3.pr, grid3.pc, cap)
-    gr3 = jax.device_put(gr.reshape(shape3), sh3)
-    gc3 = jax.device_put(gc.reshape(shape3), sh3)
-    gv3 = jax.device_put(gv.reshape(shape3), sh3)
+    gr3 = jax.device_put(grf.reshape(shape3), sh3)
+    gc3 = jax.device_put(gcf.reshape(shape3), sh3)
+    gv3 = jax.device_put(gvf.reshape(shape3), sh3)
     total = int(np.asarray(jnp.sum(A.nnz)))
 
     def route(stage_cap, tile_cap):
@@ -785,7 +799,7 @@ def spmat3d_from_spmat(
         )
 
     return _route_with_retry(
-        route, cap, (grid3.pc, grid3.pr, grid3.layers), total, A.grid.size,
+        route, cap, (grid3.pc, grid3.pr, grid3.layers), total, ndev3,
         slack, max_retries, "2D→3D conversion",
     )
 
@@ -798,14 +812,15 @@ def spmat_from_spmat3d(
     the 2D mesh, route with the 2D ``redistribute_coo``."""
     from .redistribute import redistribute_coo
 
-    assert grid2.size == A3.grid.layers * A3.grid.pr * A3.grid.pc
     gr, gc, gv = _globalize3d(A3)
-    cap = gr.shape[-1]
+    grf, cap = _rechunk(gr, grid2.size, jnp.int32(A3.nrows))
+    gcf, _ = _rechunk(gc, grid2.size, jnp.int32(A3.ncols))
+    gvf, _ = _rechunk(gv, grid2.size, jnp.zeros((), gv.dtype))
     sh2 = grid2.tile_sharding()
     shape2 = (grid2.pr, grid2.pc, cap)
-    gr2 = jax.device_put(gr.reshape(shape2), sh2)
-    gc2 = jax.device_put(gc.reshape(shape2), sh2)
-    gv2 = jax.device_put(gv.reshape(shape2), sh2)
+    gr2 = jax.device_put(grf.reshape(shape2), sh2)
+    gc2 = jax.device_put(gcf.reshape(shape2), sh2)
+    gv2 = jax.device_put(gvf.reshape(shape2), sh2)
     total = int(np.asarray(jnp.sum(A3.nnz)))
 
     def route(stage_cap, tile_cap):
@@ -817,4 +832,245 @@ def spmat_from_spmat3d(
     return _route_with_retry(
         route, cap, (grid2.pc, grid2.pr), total, grid2.size,
         slack, max_retries, "3D→2D conversion",
+    )
+
+
+# --- 3D column operations (the MCL support ops on SpParMat3D) --------------
+#
+# A col-split SpParMat3D partitions global columns over (layer, grid-col):
+# every global column lives wholly within one (l, j) tile column, spread
+# over the pr row tiles. Column reductions are therefore the SAME kernels
+# as 2D (segment-reduce per tile + psum over "r") run on the 3-axis mesh —
+# the "r" collective acts within each layer automatically because axis
+# names ARE the subcommunicators. This gives MemEfficientSpGEMM3D's prune
+# hook real MCL semantics (≈ the column ops MCLPruneRecoverySelect needs,
+# ParFriends.h:186-350, applied per layer as the reference does on its
+# per-layer layermats).
+
+COLVEC3_SPEC = P(LAYER_AXIS, COL_AXIS)
+
+
+def _check_colsplit(A3: SpParMat3D):
+    assert A3.split == "col", (
+        "3D column ops operate on col-split matrices (columns partitioned "
+        "over layer x grid-col); resplit row-split matrices first"
+    )
+
+
+@partial(jax.jit, static_argnames=("sr", "map_fn"))
+def reduce3d_cols(sr: Semiring, A3: SpParMat3D, map_fn=None) -> Array:
+    """Per-column fold over rows → [L, pc, tile_cols] (replicated over "r").
+
+    The Reduce(Column) of the 3D matrix (≈ SpParMat::Reduce on each
+    layermat)."""
+    from ..ops.segment import segment_reduce
+
+    _check_colsplit(A3)
+    tc = A3.tile_cols
+
+    def body(rows, cols, vals, nnz):
+        t = A3.local_tile(rows, cols, vals, nnz)
+        v = map_fn(t.vals) if map_fn is not None else t.vals
+        local = segment_reduce(sr, v, t.cols, tc)
+        from .collectives import axis_reduce
+
+        return axis_reduce(sr, local, ROW_AXIS)[None, None]
+
+    return jax.shard_map(
+        body,
+        mesh=A3.grid.mesh,
+        in_specs=(TILE3_SPEC,) * 4,
+        out_specs=COLVEC3_SPEC,
+        check_vma=False,
+    )(A3.rows, A3.cols, A3.vals, A3.nnz)
+
+
+@jax.jit
+def nnz_per_column3d(A3: SpParMat3D) -> Array:
+    """[L, pc, tile_cols] int32 per-column nonzero counts."""
+    _check_colsplit(A3)
+    tc = A3.tile_cols
+
+    def body(rows, cols, vals, nnz):
+        t = A3.local_tile(rows, cols, vals, nnz)
+        ids = jnp.where(t.valid_mask(), t.cols, tc)
+        local = (
+            jnp.zeros((tc,), jnp.int32).at[ids].add(1, mode="drop")
+        )
+        return lax.psum(local, ROW_AXIS)[None, None]
+
+    return jax.shard_map(
+        body,
+        mesh=A3.grid.mesh,
+        in_specs=(TILE3_SPEC,) * 4,
+        out_specs=COLVEC3_SPEC,
+        check_vma=False,
+    )(A3.rows, A3.cols, A3.vals, A3.nnz)
+
+
+@partial(jax.jit, static_argnames=("k",))
+def kselect3d(A3: SpParMat3D, k: int, kvec: Array | None = None) -> Array:
+    """Per-column k-th largest value → [L, pc, tile_cols].
+
+    The Kselect1 of the 3D matrix (≈ SpParMat::Kselect1,
+    SpParMat.cpp:1120-1742), via the same radix-select over
+    order-preserving u32 keys as the 2D path. Columns with fewer than k
+    entries return the dtype's minimum (keep-everything threshold).
+    ``kvec``: optional [L, pc, tile_cols] per-column k override.
+    """
+    from .spmat import _monotone_key_u32, _u32_key_to_val
+    from ..semiring import _minval
+
+    _check_colsplit(A3)
+    tc = A3.tile_cols
+    dtype = A3.vals.dtype
+
+    def body(rows, cols, vals, nnz, *maybe_k):
+        t = A3.local_tile(rows, cols, vals, nnz)
+        keys = _monotone_key_u32(t.vals)
+        valid = t.valid_mask()
+        ids = jnp.where(valid, t.cols, tc)
+        idx = jnp.minimum(ids, tc - 1)
+        kcol = (
+            maybe_k[0][0, 0].astype(jnp.int32)
+            if maybe_k
+            else jnp.full((tc,), k, jnp.int32)
+        )
+
+        def col_count(ge_mask):
+            local = jax.ops.segment_sum(
+                ge_mask.astype(jnp.int32), ids, num_segments=tc
+            )
+            return lax.psum(local, ROW_AXIS)
+
+        total = col_count(valid)
+        thresh = jnp.zeros((tc,), jnp.uint32)
+        for b in range(31, -1, -1):
+            cand = thresh | jnp.uint32(1 << b)
+            cnt = col_count(valid & (keys >= cand[idx]))
+            thresh = jnp.where(cnt >= kcol, cand, thresh)
+        out = _u32_key_to_val(thresh, dtype)
+        out = jnp.where(total < kcol, _minval(dtype), out)
+        return out[None, None]
+
+    args = (A3.rows, A3.cols, A3.vals, A3.nnz) + (
+        (kvec,) if kvec is not None else ()
+    )
+    vspecs = (COLVEC3_SPEC,) if kvec is not None else ()
+    return jax.shard_map(
+        body,
+        mesh=A3.grid.mesh,
+        in_specs=(TILE3_SPEC,) * 4 + vspecs,
+        out_specs=COLVEC3_SPEC,
+        check_vma=False,
+    )(*args)
+
+
+@partial(jax.jit, static_argnames=("keep",))
+def prune_column3d(A3: SpParMat3D, colvec: Array, keep) -> SpParMat3D:
+    """Keep entry (i, j) iff ``keep(val, colvec[j])``
+    (≈ SpParMat::PruneColumn, SpParMat.cpp:2567-2779)."""
+    _check_colsplit(A3)
+
+    def body(rows, cols, vals, nnz, vblk):
+        t = A3.local_tile(rows, cols, vals, nnz)
+        v = vblk[0, 0]
+        idx = jnp.minimum(t.cols, v.shape[0] - 1)
+        keepmask = t.valid_mask() & keep(t.vals, v[idx])
+        s = t._select(keepmask)
+        return (
+            s.rows[None, None, None], s.cols[None, None, None],
+            s.vals[None, None, None], s.nnz[None, None, None],
+        )
+
+    r, c, v, n = jax.shard_map(
+        body,
+        mesh=A3.grid.mesh,
+        in_specs=(TILE3_SPEC,) * 4 + (COLVEC3_SPEC,),
+        out_specs=(TILE3_SPEC,) * 4,
+        check_vma=False,
+    )(A3.rows, A3.cols, A3.vals, A3.nnz, colvec)
+    return dataclasses.replace(A3, rows=r, cols=c, vals=v, nnz=n)
+
+
+@partial(jax.jit, static_argnames=("pred",))
+def prune3d(A3: SpParMat3D, pred) -> SpParMat3D:
+    """Drop entries where ``pred(val)`` (≈ SpParMat::Prune)."""
+
+    def body(rows, cols, vals, nnz):
+        t = A3.local_tile(rows, cols, vals, nnz)
+        s = t._select(t.valid_mask() & ~pred(t.vals))
+        return (
+            s.rows[None, None, None], s.cols[None, None, None],
+            s.vals[None, None, None], s.nnz[None, None, None],
+        )
+
+    r, c, v, n = jax.shard_map(
+        body,
+        mesh=A3.grid.mesh,
+        in_specs=(TILE3_SPEC,) * 4,
+        out_specs=(TILE3_SPEC,) * 4,
+        check_vma=False,
+    )(A3.rows, A3.cols, A3.vals, A3.nnz)
+    return dataclasses.replace(A3, rows=r, cols=c, vals=v, nnz=n)
+
+
+@partial(jax.jit, static_argnames=("fn",))
+def apply3d(A3: SpParMat3D, fn) -> SpParMat3D:
+    """Elementwise value transform (≈ SpParMat::Apply)."""
+    valid = A3.rows < A3.tile_rows
+    return dataclasses.replace(
+        A3, vals=jnp.where(valid, fn(A3.vals), A3.vals)
+    )
+
+
+@partial(jax.jit, static_argnames=("fn",))
+def dim_apply3d_cols(A3: SpParMat3D, colvec: Array, fn) -> SpParMat3D:
+    """vals[i,j] = fn(vals[i,j], colvec[j]) (≈ SpParMat::DimApply(Column))."""
+    _check_colsplit(A3)
+
+    def body(rows, cols, vals, nnz, vblk):
+        t = A3.local_tile(rows, cols, vals, nnz)
+        v = vblk[0, 0]
+        vpad = jnp.concatenate([v, jnp.zeros((1,), v.dtype)])
+        idx = jnp.minimum(t.cols, v.shape[0])
+        new_vals = jnp.where(t.valid_mask(), fn(t.vals, vpad[idx]), t.vals)
+        return (
+            t.rows[None, None, None], t.cols[None, None, None],
+            new_vals[None, None, None], t.nnz[None, None, None],
+        )
+
+    r, c, v, n = jax.shard_map(
+        body,
+        mesh=A3.grid.mesh,
+        in_specs=(TILE3_SPEC,) * 4 + (COLVEC3_SPEC,),
+        out_specs=(TILE3_SPEC,) * 4,
+        check_vma=False,
+    )(A3.rows, A3.cols, A3.vals, A3.nnz, colvec)
+    return dataclasses.replace(A3, rows=r, cols=c, vals=v, nnz=n)
+
+
+def resplit3d(A3: SpParMat3D, split: str, *, slack: float = 2.0,
+              max_retries: int = 3) -> SpParMat3D:
+    """Convert between col-split and row-split layouts on the same 3D grid
+    (the orientation change MemEfficientSpGEMM3D needs between iterations:
+    SUMMA3D consumes A col-split x B row-split and produces col-split).
+
+    Globalize + 3-hop reroute; same engine as the 2D<->3D conversions.
+    """
+    if A3.split == split:
+        return A3
+    gr, gc, gv = _globalize3d(A3)
+    total = int(np.asarray(jnp.sum(A3.nnz)))
+    g3 = A3.grid
+
+    def route(stage_cap, tile_cap):
+        return redistribute_coo3d(
+            g3, gr, gc, gv, A3.nrows, A3.ncols, split=split,
+            stage_capacity=stage_cap, tile_capacity=tile_cap,
+        )
+
+    return _route_with_retry(
+        route, gr.shape[-1], (g3.pc, g3.pr, g3.layers), total,
+        g3.layers * g3.pr * g3.pc, slack, max_retries, "3D resplit",
     )

@@ -141,3 +141,115 @@ def test_3d_conversion_then_spgemm(rng):
     B3 = SpParMat3D.from_spmat(A, g3, split="row")
     C3 = spgemm3d(PLUS_TIMES, A3, B3)
     np.testing.assert_allclose(C3.to_dense(), d @ d, rtol=1e-5, atol=1e-6)
+
+
+def _colvec3d_to_global(v3, grid3, ncols):
+    """[L, pc, tc] layer-window column vector → [ncols] global order."""
+    L, pc, tc = v3.shape
+    lc = L * tc
+    out = np.zeros(pc * lc, v3.dtype)
+    for l in range(L):
+        for j in range(pc):
+            out[j * lc + l * tc : j * lc + (l + 1) * tc] = v3[l, j]
+    return out[:ncols]
+
+
+def test_3d_column_ops_match_2d(rng):
+    """reduce3d_cols / nnz_per_column3d / kselect3d / prune_column3d /
+    dim_apply3d_cols match their 2D SpParMat counterparts."""
+    import jax.numpy as jnp
+
+    from combblas_tpu.parallel.grid import Grid
+    from combblas_tpu.parallel.mesh3d import (
+        Grid3D,
+        SpParMat3D,
+        dim_apply3d_cols,
+        kselect3d,
+        nnz_per_column3d,
+        prune_column3d,
+        reduce3d_cols,
+    )
+    from combblas_tpu.parallel.spmat import SpParMat
+
+    g2 = Grid.make(2, 4)
+    g3 = Grid3D.make(2, 2, 2)
+    n = 48
+    d = random_dense(rng, n, n, 0.25)
+    A2 = SpParMat.from_dense(g2, d)
+    A3 = SpParMat3D.from_spmat(A2, g3, split="col")
+
+    sums3 = _colvec3d_to_global(
+        np.asarray(reduce3d_cols(PLUS_TIMES, A3)), g3, n
+    )
+    np.testing.assert_allclose(sums3, d.sum(axis=0), rtol=1e-5)
+
+    nnz3 = _colvec3d_to_global(np.asarray(nnz_per_column3d(A3)), g3, n)
+    np.testing.assert_array_equal(nnz3, (d != 0).sum(axis=0))
+
+    k = 3
+    ks3 = _colvec3d_to_global(np.asarray(kselect3d(A3, k)), g3, n)
+    for j in range(n):
+        colv = d[:, j][d[:, j] != 0]
+        if len(colv) >= k:
+            assert np.isclose(ks3[j], np.sort(colv)[-k], rtol=1e-6), j
+        else:
+            assert ks3[j] <= colv.min() if len(colv) else True
+
+    th = kselect3d(A3, k)
+    pruned = prune_column3d(A3, th, keep=lambda v, t: v >= t)
+    dp = pruned.to_dense()
+    for j in range(n):
+        keep = d[:, j] >= ks3[j]
+        np.testing.assert_allclose(dp[:, j], np.where(keep, d[:, j], 0))
+
+    scaled = dim_apply3d_cols(
+        A3, reduce3d_cols(PLUS_TIMES, A3),
+        lambda v, s: v / jnp.where(s != 0, s, 1),
+    )
+    cs = scaled.to_dense().sum(axis=0)
+    np.testing.assert_allclose(cs[(d != 0).any(axis=0)], 1.0, rtol=1e-5)
+
+
+def test_resplit3d_roundtrip(rng):
+    from combblas_tpu.parallel.grid import Grid
+    from combblas_tpu.parallel.mesh3d import Grid3D, SpParMat3D, resplit3d
+    from combblas_tpu.parallel.spmat import SpParMat
+
+    g2 = Grid.make(2, 4)
+    g3 = Grid3D.make(2, 2, 2)
+    n = 32
+    d = random_dense(rng, n, n, 0.2)
+    A3 = SpParMat3D.from_spmat(SpParMat.from_dense(g2, d), g3, split="col")
+    R = resplit3d(A3, "row")
+    assert R.split == "row"
+    np.testing.assert_allclose(R.to_dense(), d)
+    back = resplit3d(R, "col")
+    np.testing.assert_allclose(back.to_dense(), d)
+
+
+def test_mcl_3d_matches_2d(rng):
+    """mcl(layers=2) must produce the same clustering as the 2D path
+    (the SpGEMM3DTest equivalence pattern applied to the full pipeline)."""
+    from combblas_tpu.models.mcl import mcl
+    from combblas_tpu.parallel.grid import Grid
+    from combblas_tpu.parallel.spmat import SpParMat
+
+    # two clear 8-cliques + a sparse bridge, sized to divide 2x2x2 splits
+    n = 16
+    d = np.zeros((n, n), np.float32)
+    from combblas_tpu.parallel.mesh3d import Grid3D
+
+    d[:8, :8] = 1.0
+    d[8:, 8:] = 1.0
+    np.fill_diagonal(d, 0)
+    g2 = Grid.make(2, 2)  # square grid: 2D SUMMA + interpretation
+    A2 = SpParMat.from_dense(g2, d)
+    labels2, it2, ch2 = mcl(A2, inflation=2.0)
+    labels3, it3, ch3 = mcl(
+        A2, inflation=2.0, layers=2, grid3=Grid3D.make(2, 2, 2)
+    )
+    l2 = labels2.to_global()
+    l3 = labels3.to_global()
+    # same partition (labels are canonical smallest-member ids)
+    np.testing.assert_array_equal(l2, l3)
+    assert len(np.unique(l2)) == 2
