@@ -50,16 +50,32 @@ def main():
         grid, ru, cu, np.ones(len(ru), np.float32), n, n
     )
 
-    C = summa_spgemm(PLUS_TIMES, A, A, flop_capacity=fcap, out_capacity=ocap)
-    jax.block_until_ready(C.vals)  # warmup/compile
-    time.sleep(2)
+    # All REPS chained inside ONE launch (per-launch dispatch through the
+    # tunnel costs ~105ms-1.8s; see benchmarks/results/instrument_r2*).
+    import dataclasses
+
+    import jax.numpy as jnp
+    from jax import lax
+
+    @jax.jit
+    def chain(mat):
+        def body(_, carry):
+            a = dataclasses.replace(mat, vals=mat.vals + carry * 0)
+            C = summa_spgemm(
+                PLUS_TIMES, a, a, flop_capacity=fcap, out_capacity=ocap
+            )
+            return C.vals[0, 0, 0] * 0  # serializing dependence
+
+        return lax.fori_loop(0, REPS, body, jnp.float32(0))
+
+    out = chain(A)  # warmup/compile
+    jax.block_until_ready(out)
+    time.sleep(3)
     t0 = time.perf_counter()
-    for _ in range(REPS):
-        C = summa_spgemm(
-            PLUS_TIMES, A, A, flop_capacity=fcap, out_capacity=ocap
-        )
-    _ = float(jax.device_get(C.vals[0, 0, 0]))  # barrier
+    out = chain(A)
+    _ = float(jax.device_get(out))  # barrier
     dt = time.perf_counter() - t0
+    C = summa_spgemm(PLUS_TIMES, A, A, flop_capacity=fcap, out_capacity=ocap)
     print(
         json.dumps(
             {
@@ -67,6 +83,7 @@ def main():
                 "value": round(flops * 2 * REPS / dt / 1e6, 2),
                 "unit": "MFLOP/s",
                 "flops": int(flops),
+                "ms_per_spgemm": round(dt / REPS * 1e3, 2),
                 "out_nnz": int(jax.device_get(C.getnnz())),
             }
         )

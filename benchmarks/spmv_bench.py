@@ -43,15 +43,34 @@ def main():
         align="col",
     )
 
-    y = dist_spmv(PLUS_TIMES, E, x)  # warmup/compile
-    jax.block_until_ready(y.blocks)
-    time.sleep(2)
+    # All REPS chained inside ONE launch: per-launch dispatch through the
+    # tunnel costs ~105ms-1.8s (instrument_r2 probes), which would swamp
+    # the ~160ms kernel if launched per-rep.
+    import jax.numpy as jnp
+    from jax import lax
+
+    @jax.jit
+    def chain(ell, x0):
+        # ell passed as an ARGUMENT: a closure would embed the bucket
+        # arrays as HLO constants and blow the remote-compile size limit.
+        def body(_, xb):
+            xv = DistVec(blocks=xb, length=n, align="col", grid=grid)
+            y = dist_spmv(PLUS_TIMES, ell, xv)
+            return y.realign("col").blocks
+
+        return lax.fori_loop(0, REPS, body, x0)
+
+    out = chain(E, x.blocks)  # warmup/compile
+    jax.block_until_ready(out)
+    time.sleep(3)
     t0 = time.perf_counter()
-    for _ in range(REPS):
-        y = dist_spmv(PLUS_TIMES, E, y.realign("col"))
-    _ = float(jax.device_get(y.blocks[0, 0]))  # barrier
+    out = chain(E, x.blocks)
+    _ = float(jax.device_get(out[0, 0]))  # barrier
     dt = time.perf_counter() - t0
     gflops = len(ru) * 2 * REPS / dt / 1e9
+    ell_bytes = sum(
+        bc.size * 4 + bv.size * 4 + br.size * 4 for bc, bv, br in E.buckets
+    )
     print(
         json.dumps(
             {
@@ -60,6 +79,8 @@ def main():
                 "unit": "GFLOP/s",
                 "nnz": int(len(ru)),
                 "reps": REPS,
+                "ms_per_spmv": round(dt / REPS * 1e3, 2),
+                "achieved_GBps": round(ell_bytes * REPS / dt / 1e9, 2),
             }
         )
     )

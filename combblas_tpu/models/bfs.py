@@ -303,9 +303,11 @@ def bfs_batch(
     levels DistMultiVec, num_iters) — num_iters is the MAX level over the
     batch (lanes that finish early idle through the remaining levels with
     no semantic effect; dense-regime level cost is frontier-independent).
-    ``track_levels=False`` drops the level array from the loop carry
-    (saves one [n, W] buffer — the difference between fitting W=512 in HBM
-    or not for benchmarking; levels are then returned as parents' sign).
+    ``track_levels=False`` drops the level array from the loop carry,
+    saving one [n, W] int32 buffer (it raised the feasible batch width
+    from 256 toward 384 at scale 20 — W=512 still exceeds this chip's
+    16G HBM; see benchmarks/results/bench_sweep_r2c.txt). Levels are then
+    returned as a discovery indicator (0 discovered / -1 not).
     """
     from ..parallel.vec import DistMultiVec
     from ..parallel.ellmat import EllParMat, dist_spmv_ell_masked_multi

@@ -241,6 +241,7 @@ def test_mcl_3d_matches_2d(rng):
 
     d[:8, :8] = 1.0
     d[8:, 8:] = 1.0
+    d[7, 8] = d[8, 7] = 0.1  # the sparse bridge the prune must cut
     np.fill_diagonal(d, 0)
     g2 = Grid.make(2, 2)  # square grid: 2D SUMMA + interpretation
     A2 = SpParMat.from_dense(g2, d)

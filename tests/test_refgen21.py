@@ -1,0 +1,58 @@
+"""Golden tests for the deterministic Graph500 generator.
+
+The constants below are the output of the graph500-1.2 reference generator
+(vendored under the reference's graph500-1.2/generator, driven exactly as
+RefGen21::generate_kronecker_range does — RefGen21.h:246-263), captured
+with a standalone extractor compiled against the vendored
+splittable_mrg.c/mrg_transitions.c/utils.c. Our numpy reimplementation
+must reproduce them bit-for-bit.
+"""
+
+import numpy as np
+
+from combblas_tpu.utils.refgen21 import graph500_edges, skip_table
+
+# scale 10, M=16, userseed 0xDECAFBAD (init_random's fallback constant)
+GOLDEN_S10_SEED_DECAFBAD = np.array([[43, 928], [87, 989], [815, 345], [858, 772], [898, 176], [788, 217], [64, 996], [931, 374], [706, 527], [324, 47], [613, 263], [151, 746], [392, 630], [680, 598], [1004, 262], [54, 64]], np.int64)
+
+# scale 6, M=20, userseed 0 (the reference's -DDETERMINISTIC path)
+GOLDEN_S6_SEED0 = np.array([[20, 23], [61, 15], [17, 34], [32, 5], [20, 32], [15, 4], [1, 60], [4, 3], [58, 29], [36, 59], [20, 15], [17, 15], [12, 26], [20, 58], [17, 15], [17, 15], [50, 60], [20, 15], [12, 15], [17, 17]], np.int64)
+
+
+def test_first_edges_scale10():
+    src, dst = graph500_edges(10, nedges=16, userseed=0xDECAFBAD)
+    np.testing.assert_array_equal(src, GOLDEN_S10_SEED_DECAFBAD[:, 0])
+    np.testing.assert_array_equal(dst, GOLDEN_S10_SEED_DECAFBAD[:, 1])
+
+
+def test_first_edges_scale6_deterministic():
+    src, dst = graph500_edges(6, nedges=20, userseed=0)
+    np.testing.assert_array_equal(src, GOLDEN_S6_SEED0[:, 0])
+    np.testing.assert_array_equal(dst, GOLDEN_S6_SEED0[:, 1])
+
+
+def test_subrange_matches_full_stream():
+    """Any [start, end) window equals the same slice of the full stream —
+    the property multi-host generation relies on (RefGen21::make_graph
+    splits the edge range over ranks)."""
+    full = graph500_edges(8, nedges=64, userseed=42)
+    part = graph500_edges(8, nedges=64, userseed=42, start_edge=17,
+                          end_edge=41)
+    np.testing.assert_array_equal(part[0], full[0][17:41])
+    np.testing.assert_array_equal(part[1], full[1][17:41])
+
+
+def test_skip_table_shape_and_identity():
+    tab = skip_table()
+    assert tab.shape == (24, 256, 9)
+    # column 0 of every byte level is the identity transition
+    ident = tab[0, 0]
+    for i in range(24):
+        np.testing.assert_array_equal(tab[i, 0], ident)
+
+
+def test_edges_in_range():
+    src, dst = graph500_edges(9, nedges=512, userseed=7)
+    n = 1 << 9
+    assert src.min() >= 0 and src.max() < n
+    assert dst.min() >= 0 and dst.max() < n
