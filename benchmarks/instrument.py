@@ -286,6 +286,10 @@ def main():
     elif exp == "pallas_gather":
         out = exp_pallas_gather(int(sys.argv[2]),
                                 int(sys.argv[3]) if len(sys.argv) > 3 else 128)
+    elif exp == "sort":
+        out = exp_sort(int(sys.argv[2]), int(sys.argv[3]))
+    elif exp == "argsort":
+        out = exp_argsort(int(sys.argv[2]), int(sys.argv[3]))
     else:
         raise SystemExit(f"unknown experiment {exp}")
     out["scale"] = SCALE
@@ -456,6 +460,67 @@ def exp_pallas_gather(R: int, W: int = 128):
         "ms_per_iter": round(dt / R * 1e3, 3),
         "gather_slots": int(m),
         "Mindex_per_s": round(m * R / dt / 1e6, 1),
+    }
+
+
+
+def exp_sort(n_millions: int, R: int):
+    """XLA sort throughput on this chip: sort of N uint32 keys (the ESC
+    SpGEMM bottleneck candidate — compact() sorts the expanded tuples)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    n = n_millions * 1_000_000
+    a = jax.device_put(jnp.arange(n, dtype=jnp.uint32)[::-1])
+
+    @jax.jit
+    def run(a):
+        def body(_, carry):
+            s = jnp.sort(carry)
+            return s[::-1]  # keep it unsorted for the next iteration
+
+        return lax.fori_loop(0, R, body, a)
+
+    out = run(a)
+    jax.block_until_ready(out)
+    time.sleep(3.0)
+    dt = timed(lambda prev: run(a), 1, lambda out: int(jax.device_get(out[0])))
+    return {
+        "experiment": f"sort {n_millions}M R={R}",
+        "dt_s": round(dt, 4),
+        "ms_per_sort": round(dt / R * 1e3, 2),
+        "Mkeys_per_s": round(n * R / dt / 1e6, 1),
+    }
+
+
+def exp_argsort(n_millions: int, R: int):
+    """argsort (sort with permutation payload) — what compact() actually
+    does (sort_rowmajor carries values)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    n = n_millions * 1_000_000
+    a = jax.device_put(jnp.arange(n, dtype=jnp.uint32)[::-1])
+
+    @jax.jit
+    def run(a):
+        def body(_, carry):
+            order = jnp.argsort(carry)
+            return carry[order[::-1]]
+
+        return lax.fori_loop(0, R, body, a)
+
+    out = run(a)
+    jax.block_until_ready(out)
+    time.sleep(3.0)
+    dt = timed(lambda prev: run(a), 1, lambda out: int(jax.device_get(out[0])))
+    return {
+        "experiment": f"argsort {n_millions}M R={R}",
+        "dt_s": round(dt, 4),
+        "ms_per_argsort": round(dt / R * 1e3, 2),
+        "Mkeys_per_s": round(n * R / dt / 1e6, 1),
     }
 
 

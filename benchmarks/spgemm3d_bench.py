@@ -1,0 +1,93 @@
+"""3D (communication-avoiding) SpGEMM benchmark driver.
+
+The ``mpipspgemm`` role (≈ 3DSpGEMM/test_mpipspgemm.cpp): A·A on an R-MAT
+matrix across grid configurations L x pr x pc at fixed device count,
+reporting per-configuration wall time — the experiment that shows the
+layers/replication trade-off.
+
+Single real chip cannot host a multi-device mesh, so by default this runs
+on the virtual CPU mesh (XLA host-device-count): the numbers measure the
+SCHEDULE (collective structure, stage counts, merge sizes), not TPU
+silicon — on a real pod the same driver measures the real thing. Prints
+one JSON line per configuration.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+SCALE = int(os.environ.get("BENCH_SCALE", "12"))
+NDEV = int(os.environ.get("BENCH_NDEV", "8"))
+REPS = int(os.environ.get("BENCH_REPS", "3"))
+
+
+def main():
+    if os.environ.get("JAX_PLATFORMS", "") != "tpu":
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={NDEV}"
+        )
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS", "") != "tpu":
+        jax.config.update("jax_platforms", "cpu")
+    import math
+
+    import numpy as np
+
+    from combblas_tpu import PLUS_TIMES
+    from combblas_tpu.parallel.mesh3d import Grid3D, SpParMat3D, spgemm3d
+    from combblas_tpu.utils.rmat import rmat_symmetric_coo_host
+
+    n = 1 << SCALE
+    rows, cols = rmat_symmetric_coo_host(5, SCALE, 8)
+    key = rows * np.int64(n) + cols
+    uniq = np.unique(key)
+    ru, cu = uniq // n, uniq % n
+    vals = np.ones(len(ru), np.float32)
+
+    configs = []
+    for L in (1, 2, 4, 8):
+        if NDEV % L:
+            continue
+        p2 = NDEV // L
+        p = int(math.isqrt(p2))
+        if p * p != p2:
+            continue
+        configs.append((L, p, p))
+
+    for L, pr, pc in configs:
+        g3 = Grid3D.make(L, pr, pc)
+        # pad n so the local split divides over layers
+        lc = g3.local_cols(n)
+        if lc % L:
+            continue
+        A3 = SpParMat3D.from_global_coo(g3, ru, cu, vals, n, n, split="col")
+        B3 = SpParMat3D.from_global_coo(g3, ru, cu, vals, n, n, split="row")
+        C = spgemm3d(PLUS_TIMES, A3, B3)  # warmup/compile + sizes caches
+        jax.block_until_ready(C.vals)
+        t0 = time.perf_counter()
+        for _ in range(REPS):
+            C = spgemm3d(PLUS_TIMES, A3, B3)
+        jax.block_until_ready(C.vals)
+        dt = (time.perf_counter() - t0) / REPS
+        print(
+            json.dumps(
+                {
+                    "metric": f"spgemm3d_AxA_scale{SCALE}_L{L}x{pr}x{pc}",
+                    "value": round(dt * 1e3, 1),
+                    "unit": "ms",
+                    "out_nnz": int(jax.device_get(C.getnnz())),
+                    "ndev": NDEV,
+                }
+            )
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -16,6 +16,9 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 SCALE = int(os.environ.get("BENCH_SCALE", "14"))
 REPS = int(os.environ.get("BENCH_REPS", "3"))
+KERNEL = os.environ.get("BENCH_KERNEL", "esc")  # esc | mxu
+OCAP = os.environ.get("BENCH_OCAP")  # override out_capacity (mxu sparsify
+# cost scales with it: searchsorted queries per slot)
 
 
 def main():
@@ -57,34 +60,68 @@ def main():
     import jax.numpy as jnp
     from jax import lax
 
-    @jax.jit
-    def chain(mat):
-        def body(_, carry):
-            a = dataclasses.replace(mat, vals=mat.vals + carry * 0)
-            C = summa_spgemm(
+    if KERNEL == "mxu":
+        from combblas_tpu.parallel.spgemm import summa_spgemm_mxu
+
+        mxu_ocap = int(OCAP) if OCAP else ocap
+        mxu_overflow = None
+
+        def mult(a):
+            nonlocal mxu_overflow
+            C, mxu_overflow = summa_spgemm_mxu(
+                PLUS_TIMES, a, a, out_capacity=mxu_ocap
+            )
+            return C
+
+        # The dense accumulators are GBs; a fori_loop chain double-buffers
+        # them past HBM (device fault). Kernel time (seconds) dwarfs the
+        # per-launch dispatch, so separate launches time honestly here.
+        C = mult(A)  # warmup/compile
+        jax.block_until_ready(C.vals)
+        time.sleep(3)
+        t0 = time.perf_counter()
+        for _ in range(REPS):
+            C = mult(A)
+        _ = float(jax.device_get(C.vals[0, 0, 0]))  # barrier
+        dt = time.perf_counter() - t0
+    else:
+
+        def mult(a):
+            return summa_spgemm(
                 PLUS_TIMES, a, a, flop_capacity=fcap, out_capacity=ocap
             )
-            return C.vals[0, 0, 0] * 0  # serializing dependence
 
-        return lax.fori_loop(0, REPS, body, jnp.float32(0))
+        @jax.jit
+        def chain(mat):
+            def body(_, carry):
+                a = dataclasses.replace(mat, vals=mat.vals + carry * 0)
+                C = mult(a)
+                return C.vals[0, 0, 0] * 0  # serializing dependence
 
-    out = chain(A)  # warmup/compile
-    jax.block_until_ready(out)
-    time.sleep(3)
-    t0 = time.perf_counter()
-    out = chain(A)
-    _ = float(jax.device_get(out))  # barrier
-    dt = time.perf_counter() - t0
-    C = summa_spgemm(PLUS_TIMES, A, A, flop_capacity=fcap, out_capacity=ocap)
+            return lax.fori_loop(0, REPS, body, jnp.float32(0))
+
+        out = chain(A)  # warmup/compile
+        jax.block_until_ready(out)
+        time.sleep(3)
+        t0 = time.perf_counter()
+        out = chain(A)
+        _ = float(jax.device_get(out))  # barrier
+        dt = time.perf_counter() - t0
+        C = mult(A)
     print(
         json.dumps(
             {
-                "metric": f"spgemm_AxA_rmat_scale{SCALE}_MFLOPs",
+                "metric": f"spgemm_AxA_rmat_scale{SCALE}_{KERNEL}_MFLOPs",
                 "value": round(flops * 2 * REPS / dt / 1e6, 2),
                 "unit": "MFLOP/s",
                 "flops": int(flops),
                 "ms_per_spgemm": round(dt / REPS * 1e3, 2),
                 "out_nnz": int(jax.device_get(C.getnnz())),
+                # nonzero = BENCH_OCAP truncated the product; numbers invalid
+                "overflow": (
+                    int(jax.device_get(mxu_overflow))
+                    if KERNEL == "mxu" else 0
+                ),
             }
         )
     )

@@ -160,24 +160,33 @@ def _frontier_stats(x, deg_blocks):
     return cnt, edges
 
 
+@partial(
+    jax.jit,
+    static_argnames=("frontier_capacity", "exp_capacity", "max_iters"),
+)
 def bfs_diropt(
     A: SpParMat,
     source,
     *,
-    frontier_capacity: int | None = None,
-    exp_capacity: int | None = None,
+    frontier_capacity: int,
+    exp_capacity: int,
     max_iters: int | None = None,
 ):
-    """Direction-optimizing BFS (≈ Applications/DirOptBFS.cpp, Beamer).
+    """Direction-optimizing BFS (≈ Applications/DirOptBFS.cpp, Beamer),
+    fully on device.
 
-    Host-level per-level switch (the reference also decides per iteration):
-    run the sparse-frontier top-down kernel while the frontier fits the
-    static budgets — per-tile frontier slots (``frontier_capacity``) and
-    walked edges (``exp_capacity``) — and the dense bottom-up formulation
-    otherwise. Both regimes compile once and are reused across levels and
-    roots. On TPU the bottom-up "carousel" ring schedule is XLA's own
-    all-reduce lowering; what survives of direction optimization is the work
-    bound: top-down costs O(budgets), bottom-up costs O(tile nnz).
+    The per-level regime switch is a ``lax.cond`` on frontier statistics
+    INSIDE the while_loop — both regimes compile once and zero
+    device-to-host readbacks happen during the search (the round-1 host
+    switch permanently degraded the chip's launch path via its per-level
+    ``int(cnt)`` readbacks; see bench.py's D2H note). Top-down runs the
+    budgeted sparse-frontier kernel (work ∝ the static budgets); bottom-up
+    runs the dense masked SpMV (work ∝ tile nnz, the regime where the
+    reference's carousel operates, ``DirOptBFS.cpp:374-424``).
+
+    The caller chooses the static budgets; the switch takes top-down when
+    the frontier fits BOTH budgets with the same 1% float32 margin the
+    host version used.
 
     Returns (parents, levels, num_iters) like ``bfs``.
     """
@@ -185,51 +194,58 @@ def bfs_diropt(
     n = A.nrows
     pr_, lr = grid.pr, grid.local_rows(n)
     pc_, lc = grid.pc, grid.local_cols(A.ncols)
-    cap = A.capacity
-    if frontier_capacity is None:
-        frontier_capacity = max(64, lc // 8 + 1)
-    frontier_capacity = min(frontier_capacity, lc)
-    if exp_capacity is None:
-        exp_capacity = max(256, cap // 8 + 1)
-    exp_capacity = min(exp_capacity, cap)
     iters = max_iters if max_iters is not None else n
 
     row_gids = _global_ids(grid, pr_, lr, n, "row")
     col_gids = _global_ids(grid, pc_, lc, A.ncols, "col")
-    parents = jnp.where(row_gids == source, jnp.int32(source), -1)
-    levels = jnp.where(row_gids == source, 0, -1).astype(jnp.int32)
-    x = jnp.where(col_gids == source, jnp.int32(source), -1)
+    parents0 = jnp.where(row_gids == source, jnp.int32(source), -1)
+    levels0 = jnp.where(row_gids == source, 0, -1).astype(jnp.int32)
+    x0 = jnp.where(col_gids == source, jnp.int32(source), -1)
 
-    # Out-degree per column (structural), for the edge-budget check.
+    # out-degree per column (structural), for the edge-budget check
     deg = A.reduce(PLUS_TIMES, "rows", map_fn=ones_i32).blocks
 
-    level = jnp.int32(0)
-    it = 0
-    for it in range(1, iters + 1):
-        cnt, edges = _frontier_stats(x, deg)
-        # Host switch: budgets are per-tile worst case; the global counts
-        # bound every tile's share, so fitting globally fits locally. The 1%
-        # margin covers float32 summation error in the edge count — walking
-        # even one edge past exp_capacity would silently drop frontier edges.
-        use_topdown = (
-            int(cnt) <= frontier_capacity
-            and float(edges) <= 0.99 * exp_capacity
-        )
-        if use_topdown:
-            parents, levels, x, nnew = _diropt_topdown_step(
-                A, parents, levels, x, row_gids, level,
-                frontier_capacity, exp_capacity,
-            )
-        else:
-            parents, levels, x, nnew = _diropt_bottomup_step(
-                A, parents, levels, x, row_gids, level
-            )
-        level = level + 1
-        if int(nnew) == 0:
-            break
+    def cond(state):
+        _, _, _, level, active = state
+        return active & (level < iters)
 
+    def step(state):
+        parents, levels, x, level, _ = state
+        cnt, edges = _frontier_stats(x, deg)
+        use_topdown = (cnt <= frontier_capacity) & (
+            edges <= 0.99 * exp_capacity
+        )
+        parents, levels, x, nnew = jax.lax.cond(
+            use_topdown,
+            lambda a: _diropt_topdown_step(
+                A, a[0], a[1], a[2], row_gids, a[3],
+                frontier_capacity, exp_capacity,
+            ),
+            lambda a: _diropt_bottomup_step(
+                A, a[0], a[1], a[2], row_gids, a[3]
+            ),
+            (parents, levels, x, level),
+        )
+        return parents, levels, x, level + 1, nnew > 0
+
+    parents, levels, _, niter, _ = jax.lax.while_loop(
+        cond, step, (parents0, levels0, x0, jnp.int32(0), jnp.bool_(True))
+    )
     mk = lambda b: DistVec(blocks=b, length=n, align="row", grid=grid)
-    return mk(parents), mk(levels), it
+    return mk(parents), mk(levels), niter
+
+
+def bfs_diropt_auto(A: SpParMat, source, max_iters: int | None = None):
+    """``bfs_diropt`` with the round-1 default budget heuristics
+    (host-side, static: lc/8 frontier slots, nnz-capacity/8 edge slots)."""
+    lc = A.grid.local_cols(A.ncols)
+    cap = A.capacity
+    fc = min(max(64, lc // 8 + 1), lc)
+    ec = min(max(256, cap // 8 + 1), cap)
+    return bfs_diropt(
+        A, source, frontier_capacity=fc, exp_capacity=ec,
+        max_iters=max_iters,
+    )
 
 
 def traversed_edges(A: SpParMat, parents: DistVec) -> jax.Array:

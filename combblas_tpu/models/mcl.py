@@ -158,6 +158,7 @@ def mcl(
     add_self_loops: bool = True,
     layers: int = 1,
     grid3=None,
+    scan: bool = False,
 ) -> tuple[DistVec, int, float]:
     """Markov clustering. Returns (cluster labels, iterations, final chaos).
 
@@ -220,8 +221,10 @@ def mcl(
         ch = float("inf")
         it = 0
         for it in range(1, max_iters + 1):
+            # scan=True bounds the expansion by the output — exactly the
+            # high-collision A-squared regime where flops >> nnz_out
             A = mem_efficient_spgemm(
-                PLUS_TIMES, A, A, phases, prune_fn=prune_fn
+                PLUS_TIMES, A, A, phases, prune_fn=prune_fn, scan=scan
             )
             A = make_col_stochastic(A)
             ch = float(chaos(A))

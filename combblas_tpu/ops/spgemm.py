@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..semiring import Semiring
 from .compressed import CSR
@@ -91,4 +92,73 @@ def local_spgemm(
     """
     return expand(sr, a, b_csr, flop_capacity).compact(
         sr, capacity=out_capacity
+    )
+
+
+def densify(t: SpTuples, pad_rows: int, pad_cols: int, zero) -> Array:
+    """Tile tuples → dense [pad_rows, pad_cols] (padding cells = ``zero``).
+
+    The scatter uses sorted/unique index hints (tiles are compacted and
+    row-major sortable), which XLA can turn into a vectorized store.
+    """
+    t = t.sort_rowmajor()
+    flat = jnp.where(
+        t.valid_mask(), t.rows * pad_cols + t.cols, pad_rows * pad_cols
+    )
+    dense = jnp.full((pad_rows * pad_cols,), zero, t.vals.dtype)
+    dense = dense.at[flat].set(
+        t.vals, mode="drop", indices_are_sorted=True, unique_indices=True
+    )
+    return dense.reshape(pad_rows, pad_cols)
+
+
+def sparsify(
+    dense: Array, zero, nrows: int, ncols: int, capacity: int
+) -> tuple[SpTuples, Array]:
+    """Dense [R, C] block → (SpTuples with ``capacity`` slots, exact
+    nonzero count).
+
+    Row-structured extraction: per-row nonzero counts feed
+    ``expand_ranges`` (whose binary search runs over the tiny [R+1]
+    prefix array — cache-resident), and each slot finds its column with a
+    manual binary search over its OWN row's prefix sums. A flat
+    searchsorted over the full R*C cumsum measured 26 s for 33M queries
+    on the target chip (0.78 us/query of HBM-random binary probes); the
+    row-local formulation cuts the big-array probes ~2x and keeps the
+    heavy first search in cache.
+    """
+    from .segment import expand_ranges
+
+    R, C = dense.shape
+    mask = dense != zero
+    if C != ncols:
+        mask = mask & (jnp.arange(C, dtype=jnp.int32)[None, :] < ncols)
+    if R != nrows:
+        mask = mask & (jnp.arange(R, dtype=jnp.int32)[:, None] < nrows)
+    m32 = mask.astype(jnp.int32)
+    rowcnt = jnp.sum(m32, axis=1)
+    rowcum = jnp.cumsum(m32, axis=1).reshape(-1)  # flat [R*C]
+    owner, offset, valid, total = expand_ranges(rowcnt, capacity)
+    # smallest c with rowcum[owner, c] >= offset+1
+    want = offset + 1
+    lo = jnp.zeros((capacity,), jnp.int32)
+    hi = jnp.full((capacity,), C - 1, jnp.int32)
+    nsteps = max(int(np.ceil(np.log2(max(C, 2)))), 1)
+    base = owner * C
+    for _ in range(nsteps):
+        mid = (lo + hi) >> 1
+        v = rowcum[base + mid]
+        lo = jnp.where(v < want, mid + 1, lo)
+        hi = jnp.where(v < want, hi, mid)
+    col = hi
+    rows = jnp.where(valid, owner, nrows).astype(jnp.int32)
+    cols = jnp.where(valid, col, ncols).astype(jnp.int32)
+    vals = jnp.where(valid, dense.reshape(-1)[base + col], 0)
+    return (
+        SpTuples(
+            rows=rows, cols=cols, vals=vals,
+            nnz=jnp.minimum(total, capacity).astype(jnp.int32),
+            nrows=nrows, ncols=ncols,
+        ),
+        total,
     )

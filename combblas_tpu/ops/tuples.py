@@ -184,8 +184,15 @@ class SpTuples:
             nnz=jnp.minimum(self.nnz, jnp.int32(capacity)),
         )
 
-    def compact(self, sr: Semiring, *, capacity: int | None = None) -> "SpTuples":
-        """Sort row-major, combine duplicates with ``sr.add``, drop explicit
+    def compact_counted(
+        self, sr: Semiring, *, capacity: int | None = None
+    ) -> tuple["SpTuples", Array]:
+        """``compact`` that also returns the EXACT distinct-key count
+        (before any truncation) — the per-tile role of the reference's
+        ``estimateNNZ_Hash`` (mtSpGEMM.h:807): callers compare it against
+        ``capacity`` to detect truncation and retry with exact sizing.
+
+        Sort row-major, combine duplicates with ``sr.add``, drop explicit
         zeros, and pack valid entries to the front.
 
         Mirrors ``SpTuples::RemoveDuplicates(BinOp)`` (SpTuples.h:89) plus the
@@ -217,12 +224,17 @@ class SpTuples:
         cols = jnp.full((cap,), self.ncols, jnp.int32).at[scatter_idx].set(
             t.cols, mode="drop"
         )
-        nnz = jnp.minimum(jnp.sum(is_new).astype(jnp.int32), jnp.int32(cap))
+        distinct = jnp.sum(is_new).astype(jnp.int32)
+        nnz = jnp.minimum(distinct, jnp.int32(cap))
         out = SpTuples(
             rows=rows, cols=cols, vals=vals, nnz=nnz,
             nrows=self.nrows, ncols=self.ncols,
         )
-        return out.prune_zeros(sr)
+        return out.prune_zeros(sr), distinct
+
+    def compact(self, sr: Semiring, *, capacity: int | None = None) -> "SpTuples":
+        out, _ = self.compact_counted(sr, capacity=capacity)
+        return out
 
     def prune_zeros(self, sr: Semiring) -> "SpTuples":
         """Drop entries equal to the additive identity (compacted output)."""

@@ -918,7 +918,7 @@ def kselect3d(A3: SpParMat3D, k: int, kvec: Array | None = None) -> Array:
     entries return the dtype's minimum (keep-everything threshold).
     ``kvec``: optional [L, pc, tile_cols] per-column k override.
     """
-    from .spmat import _monotone_key_u32, _u32_key_to_val
+    from .spmat import _key_bits, _monotone_key_u32, _u32_key_to_val
     from ..semiring import _minval
 
     _check_colsplit(A3)
@@ -944,9 +944,10 @@ def kselect3d(A3: SpParMat3D, k: int, kvec: Array | None = None) -> Array:
             return lax.psum(local, ROW_AXIS)
 
         total = col_count(valid)
-        thresh = jnp.zeros((tc,), jnp.uint32)
-        for b in range(31, -1, -1):
-            cand = thresh | jnp.uint32(1 << b)
+        kt = keys.dtype
+        thresh = jnp.zeros((tc,), kt)
+        for b in range(_key_bits(dtype) - 1, -1, -1):
+            cand = thresh | jnp.asarray(1 << b, kt)
             cnt = col_count(valid & (keys >= cand[idx]))
             thresh = jnp.where(cnt >= kcol, cand, thresh)
         out = _u32_key_to_val(thresh, dtype)

@@ -300,6 +300,7 @@ def mem_efficient_spgemm(
     *,
     slack: float = 1.05,
     prune_fn=None,
+    scan: bool = False,
 ) -> SpParMat:
     """Phased SUMMA: C = A ⊗ B computed over column chunks of B.
 
@@ -311,6 +312,11 @@ def mem_efficient_spgemm(
     The reference auto-computes ``phases`` from a memory budget via
     ``EstPerProcessNnzSUMMA``; here the symbolic pass inside ``spgemm`` sizes
     each phase exactly, so callers choose ``phases`` directly.
+
+    ``scan=True`` additionally bounds each phase's EXPANSION memory by the
+    output (``spgemm_scan``'s running accumulator) — phases cap the gather
+    width, scan caps the ESC working set; together they give the
+    O(output)-memory profile of the reference's hash path.
     """
     lc = B.local_cols
     splittable = B.ncols == lc * B.grid.pc and lc % max(phases, 1) == 0
@@ -324,15 +330,20 @@ def mem_efficient_spgemm(
             stacklevel=2,
         )
         phases = 1
+    mult = (
+        (lambda a, b: spgemm_scan(sr, a, b, slack=slack))
+        if scan
+        else (lambda a, b: spgemm(sr, a, b, slack))
+    )
     if phases <= 1:
-        C = spgemm(sr, A, B, slack)
+        C = mult(A, B)
         return prune_fn(C) if prune_fn is not None else C
     outs = []
     for Bs in B.col_split(phases):
         # A phase holds ~1/phases of the nnz but inherits B's full slot
         # capacity from col_split; truncate so the per-phase SUMMA gathers
         # phase-sized arrays (the point of phasing is peak-memory reduction).
-        C = spgemm(sr, A, Bs.shrink_to_fit(), slack)
+        C = mult(A, Bs.shrink_to_fit())
         if prune_fn is not None:
             C = prune_fn(C)
         outs.append(C)
@@ -446,4 +457,303 @@ def spgemm(
         out_cap = min(1 << (out_cap - 1).bit_length(), max(dense_tile, 1))
     return summa_spgemm(
         sr, A, B, flop_capacity=flop_cap, out_capacity=out_cap
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=("sr", "flop_capacity", "out_capacity", "ring"),
+)
+def summa_spgemm_scan(
+    sr: Semiring,
+    A: SpParMat,
+    B: SpParMat,
+    *,
+    flop_capacity: int,
+    out_capacity: int,
+    ring: bool = False,
+) -> tuple[SpParMat, jax.Array]:
+    """Output-bounded SUMMA: stage expansions fold into a RUNNING
+    accumulator instead of coexisting.
+
+    ``summa_spgemm`` keeps all p stage chunks live (peak ≈ p·flop_capacity
+    slots — memory scales with FLOPs, the round-1 weakness); here each
+    stage's expansion is immediately merged into an out_capacity-slot
+    accumulator, so peak ≈ flop_capacity + 2·out_capacity slots — memory
+    scales with the OUTPUT, the property the reference gets from hash
+    accumulation (``LocalHybridSpGEMM``'s O(nnz_out) working set,
+    mtSpGEMM.h:214-440). The trade is p small sorts instead of one big one.
+
+    Returns (C, overflow): ``overflow`` is the global max, over tiles and
+    stages, of (observed distinct keys − out_capacity). Zero means C is
+    exact. Positive means truncation happened; note that once a stage
+    truncates, its dropped keys vanish from later stages' counts, so a
+    positive ``overflow`` is a LOWER BOUND on the true shortfall — always
+    a correct truncation signal, not an exact requirement.
+    ``spgemm_scan`` therefore grows capacity geometrically per retry
+    rather than trusting one measurement (the estimateNNZ_Hash role,
+    realized iteratively).
+    """
+    _check_compat(A, B)
+    grid = A.grid
+    p = grid.pr
+
+    def body(ar, ac, av, an, br, bc, bv, bn):
+        a_mine = A.local_tile(ar, ac, av, an)
+        b_mine = B.local_tile(br, bc, bv, bn)
+        acc = SpTuples.empty(
+            a_mine.nrows, b_mine.ncols, out_capacity, A.vals.dtype
+        )
+        worst = jnp.int32(0)
+
+        def merge(acc, worst, a_stage, b_stage):
+            chunk = esc_expand(
+                sr, a_stage, CSR.from_tuples(b_stage), flop_capacity
+            )
+            merged = SpTuples.concat([acc, chunk])
+            acc, distinct = merged.compact_counted(sr, capacity=out_capacity)
+            return acc, jnp.maximum(worst, distinct - out_capacity)
+
+        if not ring:
+            a_stages = _gather_stage_tiles(a_mine, COL_AXIS, p)
+            b_stages = _gather_stage_tiles(b_mine, ROW_AXIS, p)
+            for s in range(p):
+                acc, worst = merge(acc, worst, a_stages[s], b_stages[s])
+        else:
+            def joint_permute(t: SpTuples, perm) -> SpTuples:
+                return SpTuples(
+                    rows=lax.ppermute(t.rows, (ROW_AXIS, COL_AXIS), perm),
+                    cols=lax.ppermute(t.cols, (ROW_AXIS, COL_AXIS), perm),
+                    vals=lax.ppermute(t.vals, (ROW_AXIS, COL_AXIS), perm),
+                    nnz=lax.ppermute(t.nnz, (ROW_AXIS, COL_AXIS), perm),
+                    nrows=t.nrows, ncols=t.ncols,
+                )
+
+            skew_a = [
+                (i * p + (i + j) % p, i * p + j)
+                for i in range(p) for j in range(p)
+            ]
+            skew_b = [
+                (((i + j) % p) * p + j, i * p + j)
+                for i in range(p) for j in range(p)
+            ]
+            rot_a = [
+                (i * p + (j + 1) % p, i * p + j)
+                for i in range(p) for j in range(p)
+            ]
+            rot_b = [
+                (((i + 1) % p) * p + j, i * p + j)
+                for i in range(p) for j in range(p)
+            ]
+            a_cur = joint_permute(a_mine, skew_a)
+            b_cur = joint_permute(b_mine, skew_b)
+            for s in range(p):
+                acc, worst = merge(acc, worst, a_cur, b_cur)
+                if s != p - 1:
+                    a_cur = joint_permute(a_cur, rot_a)
+                    b_cur = joint_permute(b_cur, rot_b)
+
+        worst = lax.pmax(lax.pmax(worst, ROW_AXIS), COL_AXIS)
+        return SpParMat._pack_tile(acc) + (worst[None, None],)
+
+    r, c, v, n, overflow = jax.shard_map(
+        body,
+        mesh=grid.mesh,
+        in_specs=(TILE_SPEC,) * 8,
+        out_specs=(TILE_SPEC,) * 4 + (TILE_SPEC,),
+        check_vma=False,
+    )(A.rows, A.cols, A.vals, A.nnz, B.rows, B.cols, B.vals, B.nnz)
+    mat = SpParMat(
+        rows=r, cols=c, vals=v, nnz=n,
+        nrows=A.nrows, ncols=B.ncols, grid=grid,
+    )
+    return mat, overflow[0, 0]
+
+
+def spgemm_scan(
+    sr: Semiring,
+    A: SpParMat,
+    B: SpParMat,
+    *,
+    out_capacity: int | None = None,
+    slack: float = 1.1,
+    max_retries: int = 3,
+    ring: bool = False,
+) -> SpParMat:
+    """Output-bounded SpGEMM entry: size, run, retry on overflow.
+
+    The initial ``out_capacity`` guess is deliberately cheap (a fraction of
+    the clamped-flops bound); the first attempt's EXACT distinct-key count
+    then corrects it, so high-collision products (MCL's A²) never allocate
+    flops-shaped outputs. One host sync per attempt (off the hot path; on
+    the axon chip prefer a caller-provided ``out_capacity``).
+    """
+    flop_cap, flops_out_cap = summa_capacities(A, B, slack)
+    if out_capacity is None:
+        # optimistic: half the flops bound, floor at the input sizes
+        out_capacity = max(
+            min(flops_out_cap, max(A.capacity, B.capacity)), 64
+        )
+    out_capacity = 1 << (int(out_capacity) - 1).bit_length()
+    for _ in range(max_retries + 1):
+        C, overflow = summa_spgemm_scan(
+            sr, A, B, flop_capacity=flop_cap, out_capacity=out_capacity,
+            ring=ring,
+        )
+        over = int(overflow)
+        if over <= 0:
+            return C
+        # ``over`` under-reports when an early stage truncated (see
+        # summa_spgemm_scan docstring) — grow geometrically, at least 2x
+        out_capacity = max(
+            1 << (out_capacity + over - 1).bit_length(), out_capacity * 2
+        )
+    raise ValueError(
+        f"spgemm_scan still overflowing by {over} after {max_retries} "
+        "retries; pass an explicit out_capacity"
+    )
+
+
+def _pad128(x: int, to: int = 512) -> int:
+    """Pad to a Pallas/MXU-friendly multiple (512 covers the tropical
+    kernel's block sizes; plus_times only needs 128 but the extra padding
+    is noise at these sizes)."""
+    return -(-x // to) * to
+
+
+_PALLAS_KINDS = {
+    "plus_times": "plus_times",
+    "min_plus": "min_plus",
+    "max_min": "max_min",
+}
+
+
+@partial(
+    jax.jit,
+    static_argnames=("sr", "out_capacity", "interpret"),
+)
+def summa_spgemm_mxu(
+    sr: Semiring,
+    A: SpParMat,
+    B: SpParMat,
+    *,
+    out_capacity: int,
+    interpret: bool = False,
+) -> tuple[SpParMat, jax.Array]:
+    """Dense-block SUMMA: stage products run on the MATRIX UNIT.
+
+    On this TPU, XLA's sort tops out near 19-38 Mkeys/s (measured,
+    benchmarks/results/microbench_r2b.txt), capping the ESC kernel at a
+    few MFLOP/s — while the MXU delivers tens of TFLOP/s on dense blocks.
+    Below ~32K tile dims, spending n³ dense FLOPs beats sorting the sparse
+    expansion by orders of magnitude: stage tiles densify (sorted-scatter),
+    multiply via the Pallas semiring matmul (``ops/pallas_kernels`` — MXU
+    dot for plus_times, VPU chunked fold for min_plus/max_min), accumulate
+    into a DENSE [lr, lcB] buffer, and sparsify ONCE at the end (sort-free
+    cumsum + binary search). This is the "dense-block strategy for heavy
+    columns" SURVEY §7 hard-part (b) called for, taken to whole tiles.
+
+    Returns (C, overflow) like ``summa_spgemm_scan`` (overflow = max tile
+    nonzero count minus out_capacity; exact counts even when truncating).
+    SUMMA3D layers compose the same way (per-layer tiles are smaller).
+    """
+    from ..ops.pallas_kernels import semiring_matmul
+    from ..ops.spgemm import densify, sparsify
+
+    _check_compat(A, B)
+    kind = _PALLAS_KINDS.get(sr.name)
+    assert kind is not None, (
+        f"summa_spgemm_mxu supports semirings {sorted(_PALLAS_KINDS)}; "
+        f"got {sr.name} (use summa_spgemm/summa_spgemm_scan)"
+    )
+    grid = A.grid
+    p = grid.pr
+    lrA, lcA = A.local_rows, A.local_cols
+    lrB, lcB = B.local_rows, B.local_cols
+    pm, pk, pn = _pad128(lrA), _pad128(lcA), _pad128(lcB)
+    zero = float(np.asarray(sr.zero_fn(A.vals.dtype)))  # static python scalar
+
+    def body(ar, ac, av, an, br, bc, bv, bn):
+        a_mine = A.local_tile(ar, ac, av, an)
+        b_mine = B.local_tile(br, bc, bv, bn)
+        a_stages = _gather_stage_tiles(a_mine, COL_AXIS, p)
+        b_stages = _gather_stage_tiles(b_mine, ROW_AXIS, p)
+        acc = jnp.full((pm, pn), zero, A.vals.dtype)
+        for s in range(p):
+            da = densify(a_stages[s], pm, pk, zero)
+            db = densify(b_stages[s], pk, pn, zero)
+            if kind == "plus_times":
+                # XLA's own MXU tiling beats a hand-blocked kernel for the
+                # ring the hardware natively supports (measured 3.7 TFLOP/s
+                # f32 on this chip)
+                prod = jnp.dot(da, db, preferred_element_type=acc.dtype)
+            else:
+                # XLA has no MXU/VPU lowering for tropical rings — this is
+                # where the Pallas kernel earns its keep
+                prod = semiring_matmul(
+                    kind, da, db, bm=256, bk=512, bn=256,
+                    interpret=interpret,
+                )
+            acc = sr.add(acc, prod)
+        out, total = sparsify(acc, zero, lrA, lcB, out_capacity)
+        worst = jnp.maximum(total - out_capacity, 0)
+        worst = lax.pmax(lax.pmax(worst, ROW_AXIS), COL_AXIS)
+        return SpParMat._pack_tile(out) + (worst[None, None],)
+
+    r, c, v, n, overflow = jax.shard_map(
+        body,
+        mesh=grid.mesh,
+        in_specs=(TILE_SPEC,) * 8,
+        out_specs=(TILE_SPEC,) * 5,
+        check_vma=False,
+    )(A.rows, A.cols, A.vals, A.nnz, B.rows, B.cols, B.vals, B.nnz)
+    mat = SpParMat(
+        rows=r, cols=c, vals=v, nnz=n,
+        nrows=A.nrows, ncols=B.ncols, grid=grid,
+    )
+    return mat, overflow[0, 0]
+
+
+#: Above this local tile dimension the dense accumulator would exceed a
+#: few GB; the sort-based kernels take over.
+MXU_MAX_TILE_DIM = 32768
+
+
+def spgemm_auto(
+    sr: Semiring,
+    A: SpParMat,
+    B: SpParMat,
+    *,
+    out_capacity: int | None = None,
+    slack: float = 1.1,
+    max_retries: int = 3,
+    interpret: bool = False,
+) -> SpParMat:
+    """Kernel-selecting SpGEMM: dense-block MXU path when the tiles fit
+    and the semiring has a dense kernel; scanned ESC otherwise. Retries
+    with exact sizing on overflow (the estimateNNZ_Hash loop)."""
+    fits = (
+        max(A.local_rows, A.local_cols, B.local_cols) <= MXU_MAX_TILE_DIM
+        and sr.name in _PALLAS_KINDS
+    )
+    if not fits:
+        return spgemm_scan(
+            sr, A, B, out_capacity=out_capacity, slack=slack,
+            max_retries=max_retries,
+        )
+    if out_capacity is None:
+        out_capacity = max(A.capacity, B.capacity, 64)
+    out_capacity = 1 << (int(out_capacity) - 1).bit_length()
+    over = 0
+    for _ in range(max_retries + 1):
+        C, overflow = summa_spgemm_mxu(
+            sr, A, B, out_capacity=out_capacity, interpret=interpret
+        )
+        over = int(overflow)
+        if over <= 0:
+            return C
+        out_capacity = 1 << (out_capacity + over - 1).bit_length()
+    raise ValueError(
+        f"spgemm_auto still overflowing by {over} after {max_retries} "
+        "retries; pass an explicit out_capacity"
     )

@@ -47,10 +47,17 @@ Array = jax.Array
 TILE_SPEC = P(ROW_AXIS, COL_AXIS)
 
 
-def _monotone_key_u32(v: Array) -> Array:
-    """Order-preserving map of a 32-bit value array onto uint32 keys.
+def _key_bits(dtype) -> int:
+    """Radix width for ``kselect`` keys: 64 when x64 dtypes are in play."""
+    dtype = jnp.dtype(dtype)
+    return 64 if dtype.itemsize == 8 else 32
 
-    The radix-select substrate for ``kselect``: float32 uses the sign-flip
+
+def _monotone_key_u32(v: Array) -> Array:
+    """Order-preserving map of a value array onto unsigned integer keys
+    (uint32 for <=32-bit dtypes, uint64 under x64 for 64-bit ones).
+
+    The radix-select substrate for ``kselect``: floats use the sign-flip
     trick (negative floats bit-invert, positives set the MSB), signed ints
     XOR the sign bit, bools/unsigned cast. Total order matches the value
     order, so threshold search can run in integer bit-space exactly.
@@ -58,17 +65,22 @@ def _monotone_key_u32(v: Array) -> Array:
     dtype = jnp.dtype(v.dtype)
     if dtype == jnp.bool_:
         return v.astype(jnp.uint32)
+    assert dtype.itemsize in (4, 8), (
+        f"kselect supports 32/64-bit dtypes, got {dtype} (cast bf16/f16 "
+        "values to float32 first)"
+    )
+    wide = dtype.itemsize == 8
+    ut = jnp.uint64 if wide else jnp.uint32
+    sign = jnp.asarray(1 << (64 - 1 if wide else 32 - 1), ut)
+    allbits = jnp.asarray((1 << (64 if wide else 32)) - 1, ut)
+    shift = jnp.asarray(63 if wide else 31, ut)
     if jnp.issubdtype(dtype, jnp.floating):
-        assert dtype.itemsize == 4, "kselect supports 32-bit dtypes"
-        u = lax.bitcast_convert_type(v, jnp.uint32)
-        mask = jnp.where(
-            (u >> 31) != 0, jnp.uint32(0xFFFFFFFF), jnp.uint32(0x80000000)
-        )
+        u = lax.bitcast_convert_type(v, ut)
+        mask = jnp.where((u >> shift) != 0, allbits, sign)
         return u ^ mask
     if jnp.issubdtype(dtype, jnp.signedinteger):
-        assert dtype.itemsize == 4, "kselect supports 32-bit dtypes"
-        return lax.bitcast_convert_type(v, jnp.uint32) ^ jnp.uint32(0x80000000)
-    return v.astype(jnp.uint32)
+        return lax.bitcast_convert_type(v, ut) ^ sign
+    return v.astype(ut)
 
 
 def _u32_key_to_val(key: Array, dtype) -> Array:
@@ -76,13 +88,16 @@ def _u32_key_to_val(key: Array, dtype) -> Array:
     dtype = jnp.dtype(dtype)
     if dtype == jnp.bool_:
         return key.astype(jnp.bool_)
+    wide = jnp.dtype(key.dtype).itemsize == 8
+    ut = jnp.uint64 if wide else jnp.uint32
+    sign = jnp.asarray(1 << (64 - 1 if wide else 32 - 1), ut)
+    allbits = jnp.asarray((1 << (64 if wide else 32)) - 1, ut)
+    shift = jnp.asarray(63 if wide else 31, ut)
     if jnp.issubdtype(dtype, jnp.floating):
-        mask = jnp.where(
-            (key >> 31) != 0, jnp.uint32(0x80000000), jnp.uint32(0xFFFFFFFF)
-        )
+        mask = jnp.where((key >> shift) != 0, sign, allbits)
         return lax.bitcast_convert_type(key ^ mask, dtype)
     if jnp.issubdtype(dtype, jnp.signedinteger):
-        return lax.bitcast_convert_type(key ^ jnp.uint32(0x80000000), dtype)
+        return lax.bitcast_convert_type(key ^ sign, dtype)
     return key.astype(dtype)
 
 
@@ -461,6 +476,79 @@ class SpParMat:
         assert lr % nsplits == 0, f"local rows {lr} not divisible by {nsplits}"
         return list(_row_split_jit(self, nsplits))
 
+    def kselect2(self, k: int):
+        """(thresholds, any_active): ``Kselect2`` parity.
+
+        Reference: ``SpParMat::Kselect2`` (SpParMat.h:137, SpParMat.cpp) —
+        an alternative kth-largest implementation that iterates
+        median-of-medians over only the columns with >= k entries and
+        reports whether ANY column was active (callers skip the subsequent
+        prune when none was, the "k_limit >= maxNnzInColumn" early-out).
+        Here the radix-select computes the same thresholds for every
+        column in one pass, so Kselect2 reduces to kselect plus the
+        activity reduction.
+        """
+        th = self.kselect(k)
+        active = self.nnz_per_column().blocks >= k
+        return th, jnp.any(active)
+
+    def block_split(
+        self, row_blocks: int, col_blocks: int
+    ) -> list[list["SpParMat"]]:
+        """2D grid of submatrices: [row_blocks][col_blocks] pieces.
+
+        Reference: ``SpParMat::BlockSplit`` (SpParMat.cpp:2974). Splits are
+        LOCAL (each piece holds the matching chunk of every tile), composed
+        from ``row_split`` x ``col_split``.
+        """
+        rows = self.row_split(row_blocks) if row_blocks > 1 else [self]
+        return [
+            r.col_split(col_blocks) if col_blocks > 1 else [r] for r in rows
+        ]
+
+    def induced_subgraphs(
+        self, labels: DistVec, ngroups: int = 2
+    ) -> list[tuple]:
+        """Partition components into ``ngroups`` balanced groups and
+        extract each group's induced subgraph.
+
+        Reference: ``SpParMat::InducedSubgraphs2Procs``
+        (SpParMat.cpp:4916) — HipMCL's post-clustering step that ships
+        each cluster's induced subgraph to one of two process groups for
+        recursive processing. Here every group's subgraph stays a
+        first-class SpParMat on the SAME mesh (extraction is the SpRef
+        A(vi, vi) path — two permutation SpGEMMs, SpParMat.cpp:2028);
+        returns [(vertex_ids, subgraph), ...] with vertex_ids giving the
+        original ids of each subgraph's rows (host arrays; the grouping
+        decision is a host-side greedy bin-pack like the reference's).
+        """
+        from .indexing import subsref
+
+        lab = np.asarray(labels.to_global())
+        # vectorized grouping: component id -> member vertices
+        uniq, inv = np.unique(lab, return_inverse=True)
+        order = np.argsort(inv, kind="stable")
+        counts = np.bincount(inv, minlength=len(uniq))
+        bounds = np.concatenate([[0], np.cumsum(counts)])
+        members = [
+            order[bounds[i] : bounds[i + 1]] for i in range(len(uniq))
+        ]
+        # balanced greedy assignment, biggest components first
+        sizes = sorted(members, key=len, reverse=True)
+        groups = [[] for _ in range(ngroups)]
+        loads = [0] * ngroups
+        for verts in sizes:
+            g = loads.index(min(loads))
+            groups[g].extend(verts.tolist())
+            loads[g] += len(verts)
+        out = []
+        for verts in groups:
+            if not verts:
+                continue
+            vi = np.asarray(sorted(verts), dtype=np.int64)
+            out.append((vi, subsref(self, vi, vi)))
+        return out
+
     @staticmethod
     def col_concatenate(mats: list["SpParMat"]) -> "SpParMat":
         """Stitch ``col_split`` pieces (or phase outputs) back together.
@@ -772,9 +860,11 @@ def _kselect_jit(mat: SpParMat, k, kvec: DistVec | None) -> DistVec:
             return lax.psum(local, ROW_AXIS)
 
         total = col_count(valid)
-        thresh = jnp.zeros((lc,), jnp.uint32)
-        for b in range(31, -1, -1):
-            cand = thresh | jnp.uint32(1 << b)
+        nbits = _key_bits(dtype)
+        kt = keys.dtype
+        thresh = jnp.zeros((lc,), kt)
+        for b in range(nbits - 1, -1, -1):
+            cand = thresh | jnp.asarray(1 << b, kt)
             cnt = col_count(valid & (keys >= cand[idx]))
             thresh = jnp.where(cnt >= kcol, cand, thresh)
         out = _u32_key_to_val(thresh, dtype)

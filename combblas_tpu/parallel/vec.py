@@ -506,3 +506,43 @@ class DistMultiVec:
         return DistMultiVec(
             blocks=blocks, length=self.length, align=align, grid=grid
         )
+
+
+def concatenate(vecs, grid: "Grid | None" = None, align: str | None = None,
+                fill=0) -> DistVec:
+    """Cross-grid vector concatenation (≈ ``Concatenate``,
+    ParFriends.h:61-159).
+
+    The reference stitches FullyDistVecs living on DIFFERENT process grids
+    into one vector on the union grid via pairwise exchanges. Here vectors
+    may live on different meshes (or the same one): each input's blocks
+    are flattened device-side, concatenated in order, re-padded, and
+    device_put onto the target grid's sharding — XLA moves the bytes
+    between device sets at the jit boundary. ``grid`` defaults to the
+    first vector's grid; ``align`` to the first vector's alignment.
+    """
+    assert vecs, "concatenate needs at least one vector"
+    grid = grid or vecs[0].grid
+    align = align or vecs[0].align
+    pa = grid.pr if align == "row" else grid.pc
+    total = sum(v.length for v in vecs)
+    # inputs may live on different device sets: land every part on the
+    # TARGET mesh (replicated) before concatenating — the cross-grid hop
+    rep = NamedSharding(grid.mesh, P())
+    parts = [
+        jax.device_put(v.blocks.reshape(-1)[: v.length], rep) for v in vecs
+    ]
+    flat = jnp.concatenate(parts)
+    L = -(-total // pa)
+    pad = pa * L - total
+    if pad:
+        flat = jnp.concatenate(
+            [flat, jnp.full((pad,), fill, flat.dtype)]
+        )
+    sharding = NamedSharding(
+        grid.mesh, P(ROW_AXIS if align == "row" else COL_AXIS)
+    )
+    return DistVec(
+        blocks=jax.device_put(flat.reshape(pa, L), sharding),
+        length=total, align=align, grid=grid,
+    )

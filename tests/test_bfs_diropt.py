@@ -8,7 +8,8 @@ import jax
 import numpy as np
 import pytest
 
-from combblas_tpu.models.bfs import bfs, bfs_diropt, validate_bfs_tree
+from combblas_tpu.models.bfs import (bfs, bfs_diropt, bfs_diropt_auto,
+                                     validate_bfs_tree)
 from combblas_tpu.parallel.grid import Grid
 from combblas_tpu.parallel.spmat import SpParMat
 from combblas_tpu.utils.rmat import rmat_symmetric_coo
@@ -27,7 +28,7 @@ def test_diropt_matches_levelsync(rng, pr, pc):
     d = _sym_random(rng, 24, 0.12)
     A = SpParMat.from_dense(grid, d)
     p1, l1, _ = bfs(A, 0)
-    p2, l2, _ = bfs_diropt(A, 0)
+    p2, l2, _ = bfs_diropt_auto(A, 0)
     # Parents may differ (any valid tree); levels must match exactly.
     np.testing.assert_array_equal(l1.to_global(), l2.to_global())
     assert not validate_bfs_tree(d, 0, p2.to_global(), l2.to_global())
@@ -67,5 +68,5 @@ def test_diropt_rmat(rng):
         grid, rows, cols, np.ones(len(rows), np.float32), n, n
     )
     dense = A.to_dense()
-    p, l, _ = bfs_diropt(A, 1)
+    p, l, _ = bfs_diropt_auto(A, 1)
     assert not validate_bfs_tree(dense != 0, 1, p.to_global(), l.to_global())

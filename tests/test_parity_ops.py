@@ -167,3 +167,90 @@ def test_pallas_min_plus_repeated_squaring(rng):
     for _ in range(8):
         got = jnp.minimum(got, min_plus_matmul(got, got, interpret=True))
     np.testing.assert_allclose(np.asarray(got), expect, rtol=1e-4, atol=1e-3)
+
+
+def test_kselect2_parity(rng):
+    """Kselect2 = kselect thresholds + any-column-active flag
+    (SpParMat.h:137)."""
+    grid = Grid.make(2, 2)
+    n = 24
+    d = (rng.random((n, n)) < 0.3).astype(np.float32) * (
+        1 + rng.random((n, n)).astype(np.float32)
+    )
+    A = SpParMat.from_dense(grid, d)
+    th, active = A.kselect2(3)
+    assert bool(active) == bool(((d != 0).sum(axis=0) >= 3).any())
+    th2 = A.kselect(3)
+    np.testing.assert_array_equal(
+        np.asarray(th.blocks), np.asarray(th2.blocks)
+    )
+    _, none_active = A.kselect2(n + 1)
+    assert not bool(none_active)
+
+
+def test_block_split(rng):
+    """BlockSplit (SpParMat.cpp:2974): 2D submatrix grid, reassembled."""
+    grid = Grid.make(2, 2)
+    n = 32
+    d = (rng.random((n, n)) < 0.2).astype(np.float32)
+    A = SpParMat.from_dense(grid, d)
+    blocks = A.block_split(2, 2)
+    assert len(blocks) == 2 and len(blocks[0]) == 2
+    # row_split is local-strided; verify via nnz conservation + col stitch
+    total = sum(
+        int(np.asarray(b.getnnz())) for row in blocks for b in row
+    )
+    assert total == int((d != 0).sum())
+    stitched = SpParMat.col_concatenate(blocks[0])
+    assert stitched.ncols == n
+
+
+def test_induced_subgraphs(rng):
+    """InducedSubgraphs2Procs (SpParMat.cpp:4916): component groups ->
+    induced subgraphs via SpRef."""
+    from combblas_tpu.models.cc import connected_components
+
+    grid = Grid.make(2, 2)
+    n = 24
+    d = np.zeros((n, n), np.float32)
+    d[:6, :6] = 1.0  # clique A
+    d[8:12, 8:12] = 1.0  # clique B
+    d[16:18, 16:18] = 1.0  # tiny pair
+    np.fill_diagonal(d, 0)
+    A = SpParMat.from_dense(grid, d)
+    labels, _ = connected_components(A)
+    groups = A.induced_subgraphs(labels, ngroups=2)
+    assert len(groups) == 2
+    total_verts = sum(len(vi) for vi, _ in groups)
+    assert total_verts == n
+    total_nnz = sum(int(np.asarray(sub.getnnz())) for _, sub in groups)
+    assert total_nnz == int((d != 0).sum())  # components never split
+    for vi, sub in groups:
+        np.testing.assert_allclose(
+            sub.to_dense()[: len(vi), : len(vi)], d[np.ix_(vi, vi)]
+        )
+
+
+def test_cross_grid_concatenate(rng):
+    """Concatenate (ParFriends.h:61-159): vectors from different grids."""
+    from combblas_tpu.parallel.vec import concatenate
+
+    g1 = Grid.make(2, 2)
+    g2 = Grid.make(2, 4)
+    x1 = rng.random(10).astype(np.float32)
+    x2 = rng.random(17).astype(np.float32)
+    v1 = DistVec.from_global(g1, x1, align="row")
+    v2 = DistVec.from_global(g2, x2, align="row")
+    out = concatenate([v1, v2], grid=g2)
+    assert out.length == 27
+    np.testing.assert_allclose(out.to_global(), np.concatenate([x1, x2]))
+
+
+def test_multihost_single_process():
+    """init_distributed is a no-op single-process and reports devices."""
+    from combblas_tpu.parallel.multihost import init_distributed, make_global_grid
+
+    nd = init_distributed()
+    assert nd >= 1
+    g = make_global_grid()
+    assert g.size <= nd
