@@ -22,7 +22,12 @@ benchmarks/results/instrument_r2_raw*.txt):
     [n, 64] frontier matrix (bfs_batch; SURVEY §2.3 strategy 7), so the
     per-index cost is split 64 ways;
   * kernel-2 TEPS accounting runs on device (batch_traversed_edges); the
-    only D2H is one [W] vector + the sync scalar, AFTER timing.
+    only D2H is one [W] vector + the sync scalar, AFTER timing;
+  * the search loop carries int8 LEVEL indicators (1 byte/root per
+    gathered index instead of 4) and reconstructs parents in one final
+    sweep (bfs_batch_compact) — the gather is payload-width sensitive
+    above ~256B/index, so the byte-wide frontier cuts dense-level cost
+    further and halves HBM state.
 Operating point (measured sweep, benchmarks/results/bench_sweep_r2*.txt):
 scale 20 x 256 roots = 217.8 MTEPS; W=384+ exceeds the 16G HBM at scale 20,
 W=512 at scale 19 also OOMs; scale 21 x 256 OOMs. Round-1 single-root
@@ -54,7 +59,7 @@ def main():
     import jax
     import numpy as np
 
-    from combblas_tpu.models.bfs import batch_traversed_edges, bfs_batch
+    from combblas_tpu.models.bfs import batch_traversed_edges, bfs_batch_compact
     from combblas_tpu.parallel.ellmat import EllParMat
     from combblas_tpu.parallel.grid import Grid
     from combblas_tpu.parallel.vec import DistVec
@@ -89,13 +94,13 @@ def main():
     # reliable barrier through the tunnel, so sleep covers the drain and the
     # timed section is closed by the te readback (its ~5 ms inflates dt,
     # biasing reported TEPS DOWN).
-    p, _, _ = bfs_batch(E, roots_dev, track_levels=False)
+    p, _, _ = bfs_batch_compact(E, roots_dev)
     te_dev = batch_traversed_edges(deg_blocks, p)
     jax.block_until_ready(te_dev)
     time.sleep(5.0)
 
     t0 = time.perf_counter()
-    parents, _, _ = bfs_batch(E, roots_dev, track_levels=False)
+    parents, _, _ = bfs_batch_compact(E, roots_dev)
     te_dev = batch_traversed_edges(deg_blocks, parents)
     te = np.asarray(jax.device_get(te_dev))  # true barrier
     dt_total = time.perf_counter() - t0

@@ -135,6 +135,10 @@ class SpTuples:
     # --- structural transforms -------------------------------------------
 
     def sort_rowmajor(self) -> "SpTuples":
+        # A fused single-uint32-key variant was tried and measured on the
+        # target chip: no improvement over the two-key sort
+        # (benchmarks/results/microbench_r2f.txt, 28.6s vs 26.6s) — the
+        # sort is bandwidth/pass-bound, not operand-count-bound.
         r, c, v = lax.sort((self.rows, self.cols, self.vals), num_keys=2)
         return dataclasses.replace(self, rows=r, cols=c, vals=v)
 

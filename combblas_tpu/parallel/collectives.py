@@ -49,3 +49,28 @@ def axis_reduce_scatter(sr: Semiring, x: jax.Array, axis_name) -> jax.Array:
     idx = lax.axis_index(axis_name)
     chunk = x.shape[0] // size
     return lax.dynamic_slice_in_dim(full, idx * chunk, chunk, axis=0)
+
+
+def axis_ring_reduce(sr: Semiring, x: jax.Array, axis_name) -> jax.Array:
+    """All-reduce via an explicit neighbor ring — the carousel schedule.
+
+    The reference's bottom-up BFS rotates bitmap ownership around the
+    process row in ``numcols`` sub-steps with neighbor-only traffic
+    (``BFSFriends.h:457-560``, ``BitMapCarousel.h:192``). The TPU-native
+    twin is a ``ppermute`` ring over the mesh axis: each of the
+    ``size-1`` steps shifts the running partial one neighbor over ICI and
+    folds it in — semantically identical to ``axis_reduce`` (the fused
+    XLA all-reduce), structurally the pipelined neighbor-rotation
+    schedule. Exposed so ring-scheduled kernels (``ring=True`` paths) are
+    real, testable programs rather than a claim about XLA's lowering.
+    """
+    size = lax.axis_size(axis_name)
+    if size == 1:
+        return x
+    perm = [(i, (i + 1) % size) for i in range(size)]
+    acc = x
+    cur = x
+    for _ in range(size - 1):
+        cur = lax.ppermute(cur, axis_name, perm)
+        acc = sr.add(acc, cur)
+    return acc

@@ -380,3 +380,104 @@ def dist_spmv_ell_masked_multi(
     return DistMultiVec(
         blocks=blocks, length=E.nrows, align="row", grid=E.grid
     )
+
+
+@partial(jax.jit, static_argnames=("ring",))
+def _ell_levels_step(E: EllParMat, x8, undiscovered8, ring: bool = False):
+    """One batched BFS level over int8 indicator frontiers.
+
+    x8: [pc, lc, W] int8 col-aligned (1 = in frontier); undiscovered8:
+    [pr, lr, W] int8 row-aligned (1 = not yet discovered). Returns
+    reached8 [pr, lr, W]: 1 where an undiscovered row has a frontier
+    in-neighbor. The gather payload is W BYTES per index instead of the
+    4W of the parent-carrying kernel — on per-index-bound gather hardware
+    with payload-width sensitivity above ~256B this is the difference
+    between ~0.45s and ~1.6s per level at scale 20 x W=256.
+    """
+    lr, lc = E.local_rows, E.local_cols
+    nb = len(E.buckets)
+
+    def body(xblk, ublk, *flat):
+        buckets = [
+            tuple(a[0, 0] for a in flat[3 * i : 3 * i + 3]) for i in range(nb)
+        ]
+        x = xblk[0]  # [lc, W] int8
+        W = x.shape[1]
+        xpad = jnp.concatenate([x, jnp.zeros((1, W), jnp.int8)])
+        y = None
+        for bc, _bv, br in buckets:
+            g = xpad[jnp.minimum(bc, lc)]  # [nb, kb, W] int8
+            yb = jnp.max(g, axis=1)  # [nb, W]
+            if y is None:
+                y = jnp.zeros((lr, W), jnp.int8)
+            y = y.at[br].max(yb, mode="drop")
+        if y is None:
+            y = jnp.zeros((lr, x.shape[1]), jnp.int8)
+        y = jnp.minimum(y, ublk[0])  # only undiscovered rows fire
+        if ring:
+            # the carousel schedule: neighbor ppermute rotation over the
+            # row communicator instead of the fused all-reduce
+            from ..semiring import SELECT2ND_MAX
+            from .collectives import axis_ring_reduce
+
+            return axis_ring_reduce(SELECT2ND_MAX, y, COL_AXIS)[None]
+        return lax.pmax(y, COL_AXIS)[None]
+
+    flat_args = [a for b in E.buckets for a in b]
+    return jax.shard_map(
+        body,
+        mesh=E.grid.mesh,
+        in_specs=(P(COL_AXIS), P(ROW_AXIS)) + (TILE_SPEC,) * (3 * nb),
+        out_specs=P(ROW_AXIS),
+        # the ring fold provably replicates over "c" (a full rotation
+        # visits every neighbor) but shard_map cannot infer that through
+        # ppermute — same situation as DistVec.realign; the default pmax
+        # path keeps the check on
+        check_vma=not ring,
+    )(x8, undiscovered8, *flat_args)
+
+
+@partial(jax.jit, static_argnames=())
+def _ell_parents_from_levels(E: EllParMat, levels_col, levels_row):
+    """Parent reconstruction: for every (row, root) pick the max-id
+    in-neighbor whose level is exactly level(row)-1.
+
+    levels_col: [pc, lc, W] int8 (col-aligned levels, -1 undiscovered);
+    levels_row: [pr, lr, W]. One W-byte-payload gather pass over the
+    matrix — the whole-search parent information the compact BFS loop
+    deliberately did not carry.
+    """
+    lr, lc = E.local_rows, E.local_cols
+    nb = len(E.buckets)
+
+    def body(lcb, lrb, *flat):
+        buckets = [
+            tuple(a[0, 0] for a in flat[3 * i : 3 * i + 3]) for i in range(nb)
+        ]
+        lvl_c = lcb[0]  # [lc, W] int8
+        W = lvl_c.shape[1]
+        lvl_r = lrb[0]  # [lr, W] int8
+        cpad = jnp.concatenate([lvl_c, jnp.full((1, W), -1, jnp.int8)])
+        j = lax.axis_index(COL_AXIS)
+        col_base = j * lc
+        y = jnp.full((lr, W), -1, jnp.int32)
+        for bc, _bv, br in buckets:
+            safe = jnp.minimum(bc, lc)
+            g = cpad[safe]  # [nb, kb, W] int8 neighbor levels
+            want = jnp.where(
+                lvl_r > 0, lvl_r - 1, jnp.int8(-2)
+            )  # rows at level 0 (roots) or undiscovered never match
+            wantb = want[jnp.minimum(br, lr - 1)][:, None, :]  # [nb,1,W]
+            gid = (col_base + safe).astype(jnp.int32)[:, :, None]  # [nb,kb,1]
+            cand = jnp.where(g == wantb, gid, -1)  # [nb, kb, W] int32
+            yb = jnp.max(cand, axis=1)  # [nb, W]
+            y = y.at[br].max(yb, mode="drop")
+        return lax.pmax(y, COL_AXIS)[None]
+
+    flat_args = [a for b in E.buckets for a in b]
+    return jax.shard_map(
+        body,
+        mesh=E.grid.mesh,
+        in_specs=(P(COL_AXIS), P(ROW_AXIS)) + (TILE_SPEC,) * (3 * nb),
+        out_specs=P(ROW_AXIS),
+    )(levels_col, levels_row, *flat_args)
