@@ -52,6 +52,8 @@ import time
 SCALE = int(os.environ.get("BENCH_SCALE", "20"))
 EDGEFACTOR = int(os.environ.get("BENCH_EDGEFACTOR", "16"))
 NROOTS = int(os.environ.get("BENCH_NROOTS", "256"))
+DIROPT = os.environ.get("BENCH_DIROPT", "0") == "1"  # union-frontier sparse
+# levels (budgets below); measured configuration notes in PERF_NOTES_r2.md
 BASELINE_MTEPS = 1636.0  # Hopper 1024 cores, R-MAT "mini"
 
 
@@ -84,6 +86,14 @@ def main():
     E = EllParMat.from_host_coo(
         grid, rows_u, cols_u, np.ones(nnz, np.float32), n, n
     )
+    csc = None
+    fcap = ecap = None
+    if DIROPT:
+        from combblas_tpu.parallel.ellmat import build_csc_companion
+
+        csc = build_csc_companion(grid, rows_u, cols_u, n, n)
+        fcap = grid.local_cols(n) // 8
+        ecap = max(nnz // 16, 1 << 20)
     deg_blocks = DistVec.from_global(
         grid, deg.astype(np.int32), align="row"
     ).blocks
@@ -94,13 +104,17 @@ def main():
     # reliable barrier through the tunnel, so sleep covers the drain and the
     # timed section is closed by the te readback (its ~5 ms inflates dt,
     # biasing reported TEPS DOWN).
-    p, _, _ = bfs_batch_compact(E, roots_dev)
+    p, _, _ = bfs_batch_compact(
+        E, roots_dev, csc=csc, frontier_capacity=fcap, edge_capacity=ecap
+    )
     te_dev = batch_traversed_edges(deg_blocks, p)
     jax.block_until_ready(te_dev)
     time.sleep(5.0)
 
     t0 = time.perf_counter()
-    parents, _, _ = bfs_batch_compact(E, roots_dev)
+    parents, _, _ = bfs_batch_compact(
+        E, roots_dev, csc=csc, frontier_capacity=fcap, edge_capacity=ecap
+    )
     te_dev = batch_traversed_edges(deg_blocks, parents)
     te = np.asarray(jax.device_get(te_dev))  # true barrier
     dt_total = time.perf_counter() - t0

@@ -45,8 +45,11 @@ from .parallel.spgemm import (
     estimate_nnz_upper,
     mem_efficient_spgemm,
     spgemm,
+    spgemm_auto,
+    spgemm_scan,
 )
 from .parallel.spmv import dist_spmspv, dist_spmv, dist_spmv_masked
+from .parallel.vec import DistMultiVec, concatenate
 from .parallel.indexing import spasgn, subsref
 from .semantic import SemanticGraph, filtered_bfs, filtered_mis
 
@@ -62,9 +65,11 @@ __all__ = [
     "Grid", "Grid3D", "SpParMat", "SpParMat3D", "DenseParMat", "EllParMat",
     "DistVec",
     # distributed algebra
-    "spgemm", "mem_efficient_spgemm", "block_spgemm", "spgemm3d",
+    "spgemm", "spgemm_scan", "spgemm_auto", "mem_efficient_spgemm",
+    "block_spgemm", "spgemm3d",
     "estimate_flops", "estimate_nnz_upper", "calculate_phases",
     "dist_spmv", "dist_spmv_masked", "dist_spmspv", "subsref", "spasgn",
+    "concatenate", "DistMultiVec",
     # semantic graphs
     "SemanticGraph", "filtered_bfs", "filtered_mis",
 ]

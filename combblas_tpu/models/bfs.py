@@ -399,9 +399,15 @@ def batch_traversed_edges(deg_row_blocks, parents) -> jax.Array:
     return te // 2
 
 
-@partial(jax.jit, static_argnames=("max_iters", "ring"))
+@partial(
+    jax.jit,
+    static_argnames=("max_iters", "ring", "frontier_capacity",
+                     "edge_capacity"),
+)
 def bfs_batch_compact(A, sources, max_iters: int | None = None,
-                      ring: bool = False):
+                      ring: bool = False, csc=None,
+                      frontier_capacity: int | None = None,
+                      edge_capacity: int | None = None):
     """Level-compressed multi-source BFS: int8 frontiers, parents
     reconstructed in ONE pass after the search.
 
@@ -422,6 +428,15 @@ def bfs_batch_compact(A, sources, max_iters: int | None = None,
     BitMapCarousel analog, neighbor-only ICI traffic) instead of the
     fused all-reduce; results are identical.
 
+    Direction optimization for the batch: pass ``csc`` (the
+    ``ellmat.build_csc_companion`` arrays) plus static ``frontier_capacity``
+    / ``edge_capacity`` budgets, and each level checks ON DEVICE whether
+    the UNION of all W frontiers fits the budgets — if so it walks only
+    those columns' edges (cost ∝ budgets) instead of the full dense sweep
+    (cost ∝ nnz). First levels and the straggler tail of a 256-root batch
+    are exactly this regime. ``lax.cond`` keeps both kernels compiled
+    once; zero host readbacks.
+
     Returns (parents DistMultiVec int32, levels DistMultiVec int8,
     num_iters) with the same conventions as ``bfs_batch``.
     """
@@ -429,8 +444,11 @@ def bfs_batch_compact(A, sources, max_iters: int | None = None,
         EllParMat,
         _ell_levels_step,
         _ell_parents_from_levels,
+        _ell_union_sparse_step,
     )
     from ..parallel.vec import DistMultiVec
+    from ..parallel.grid import COL_AXIS, ROW_AXIS
+    from jax.sharding import PartitionSpec as P
 
     grid = A.grid
     n = A.nrows
@@ -457,6 +475,26 @@ def bfs_batch_compact(A, sources, max_iters: int | None = None,
     def mk(b, align):
         return DistMultiVec(blocks=b, length=n, align=align, grid=grid)
 
+    diropt = (
+        csc is not None
+        and frontier_capacity is not None
+        and edge_capacity is not None
+    )
+    if diropt:
+        csc_indptr, csc_rowidx = csc
+
+        def colde_body(ipt):
+            d = ipt[0, 0][1:] - ipt[0, 0][:-1]
+            return jax.lax.psum(d, ROW_AXIS)[None]
+
+        coldeg = jax.shard_map(
+            colde_body,
+            mesh=grid.mesh,
+            in_specs=(P(ROW_AXIS, COL_AXIS),),
+            out_specs=P(COL_AXIS),
+            check_vma=False,
+        )(csc_indptr)  # [pc, lc] per-column degrees
+
     def cond(state):
         _, _, level, active = state
         return active & (level < iters)
@@ -464,7 +502,24 @@ def bfs_batch_compact(A, sources, max_iters: int | None = None,
     def step(state):
         levels, x, level, _ = state
         undisc = (levels < 0).astype(jnp.int8)
-        reached = _ell_levels_step(A, x, undisc, ring=ring)
+        if diropt:
+            act = jnp.max(x, axis=2) > 0  # [pc, lc] union frontier
+            cnt = jnp.sum(act.astype(jnp.int32))
+            edges = jnp.sum(jnp.where(act, coldeg, 0))
+            use_sparse = (cnt <= frontier_capacity) & (
+                edges <= edge_capacity
+            )
+            reached = jax.lax.cond(
+                use_sparse,
+                lambda a: _ell_union_sparse_step(
+                    A, csc_indptr, csc_rowidx, a[0], a[1],
+                    frontier_capacity, edge_capacity,
+                ),
+                lambda a: _ell_levels_step(A, a[0], a[1], ring=ring),
+                (x, undisc),
+            )
+        else:
+            reached = _ell_levels_step(A, x, undisc, ring=ring)
         new = reached > 0
         levels = jnp.where(new, (level + 1).astype(jnp.int8), levels)
         x_next = mk(reached, "row").realign("col").blocks

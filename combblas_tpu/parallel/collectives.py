@@ -63,7 +63,15 @@ def axis_ring_reduce(sr: Semiring, x: jax.Array, axis_name) -> jax.Array:
     XLA all-reduce), structurally the pipelined neighbor-rotation
     schedule. Exposed so ring-scheduled kernels (``ring=True`` paths) are
     real, testable programs rather than a claim about XLA's lowering.
+
+    Requires a COMMUTATIVE add (each device folds the rotation in a
+    different order); the native-kind monoids all are, generic monoids
+    are rejected rather than silently diverging per device.
     """
+    assert sr.add_kind in ("sum", "min", "max"), (
+        f"axis_ring_reduce needs a commutative add monoid; semiring "
+        f"{sr.name} has add_kind={sr.add_kind!r} — use axis_reduce"
+    )
     size = lax.axis_size(axis_name)
     if size == 1:
         return x

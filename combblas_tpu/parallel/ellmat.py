@@ -481,3 +481,101 @@ def _ell_parents_from_levels(E: EllParMat, levels_col, levels_row):
         in_specs=(P(COL_AXIS), P(ROW_AXIS)) + (TILE_SPEC,) * (3 * nb),
         out_specs=P(ROW_AXIS),
     )(levels_col, levels_row, *flat_args)
+
+
+# --- budgeted union-frontier sparse step (direction optimization for the
+# BATCHED search; ≈ the top-down regime of DirOptBFS applied to all W
+# roots at once) -------------------------------------------------------------
+
+
+def build_csc_companion(grid: Grid, rows, cols, nrows: int, ncols: int):
+    """Host build of per-tile CSC structure arrays for column walks:
+    (indptr [pr, pc, lc+1], rowidx [pr, pc, cap]) int32, cap = max tile
+    nnz. The EllParMat's row buckets cannot walk COLUMNS; sparse
+    union-frontier steps need exactly that (the reference's SpImpl CSC
+    kernels, SpImpl.cpp:345-600)."""
+    import numpy as np
+
+    from .spmat import bucket_by_tile
+
+    rows, cols, order, counts, starts, _cap, lr, lc = bucket_by_tile(
+        grid, rows, cols, nrows, ncols, None
+    )
+    pr_, pc_ = grid.pr, grid.pc
+    cap = max(int(counts.max()), 1)
+    indptr = np.zeros((pr_, pc_, lc + 1), np.int32)
+    rowidx = np.full((pr_, pc_, cap), lr, np.int32)
+    for t in range(grid.size):
+        i, j = divmod(t, pc_)
+        s0, e0 = starts[t], starts[t + 1]
+        r = rows[s0:e0] - i * lr
+        c = cols[s0:e0] - j * lc
+        o = np.argsort(c, kind="stable")
+        r, c = r[o], c[o]
+        indptr[i, j] = np.searchsorted(c, np.arange(lc + 1))
+        rowidx[i, j, : e0 - s0] = r
+    sh = grid.tile_sharding()
+    import jax.numpy as jnp
+
+    return (
+        jax.device_put(jnp.asarray(indptr), sh),
+        jax.device_put(jnp.asarray(rowidx), sh),
+    )
+
+
+@partial(jax.jit, static_argnames=("frontier_capacity", "edge_capacity"))
+def _ell_union_sparse_step(
+    E: EllParMat, csc_indptr, csc_rowidx, x8, undiscovered8,
+    frontier_capacity: int, edge_capacity: int,
+):
+    """One batched BFS level touching ONLY the union-frontier columns.
+
+    The dense level costs ~nnz gathers regardless of frontier size; when
+    the UNION of all W frontiers is small (first levels, straggler tail),
+    walking just those columns' edges costs ~edge_capacity instead. The
+    caller guarantees the budgets (on-device cond in bfs_batch_compact).
+    Semantics identical to _ell_levels_step.
+    """
+    from ..ops.segment import expand_ranges
+
+    lr, lc = E.local_rows, E.local_cols
+
+    def body(ipt, ridx, xblk, ublk):
+        indptr = ipt[0, 0]  # [lc+1]
+        rowid = ridx[0, 0]  # [cap]
+        x = xblk[0]  # [lc, W] int8
+        W = x.shape[1]
+        act = jnp.max(x, axis=1) > 0  # [lc] union frontier
+        # compact active local columns into F slots
+        pos = jnp.cumsum(act.astype(jnp.int32)) - 1
+        scatter = jnp.where(act, pos, frontier_capacity)
+        fcols = (
+            jnp.full((frontier_capacity,), lc, jnp.int32)
+            .at[scatter]
+            .set(jnp.arange(lc, dtype=jnp.int32), mode="drop")
+        )
+        ipt_pad = jnp.concatenate([indptr, indptr[-1:]])
+        deg = jnp.where(
+            fcols < lc, ipt_pad[fcols + 1] - ipt_pad[fcols], 0
+        )
+        owner, offset, valid, _ = expand_ranges(deg, edge_capacity)
+        src_col = fcols[owner]  # local col of this edge
+        slot = jnp.minimum(ipt_pad[jnp.minimum(src_col, lc)] + offset,
+                           rowid.shape[0] - 1)
+        tgt_row = jnp.where(valid, rowid[slot], lr)
+        # per-root frontier value of the edge's source column: [Ecap, W]
+        xpad = jnp.concatenate([x, jnp.zeros((1, W), jnp.int8)])
+        contrib = xpad[jnp.minimum(src_col, lc)]
+        contrib = jnp.where(valid[:, None], contrib, 0)
+        y = jnp.zeros((lr, W), jnp.int8).at[tgt_row].max(
+            contrib, mode="drop"
+        )
+        y = jnp.minimum(y, ublk[0])
+        return lax.pmax(y, COL_AXIS)[None]
+
+    return jax.shard_map(
+        body,
+        mesh=E.grid.mesh,
+        in_specs=(TILE_SPEC, TILE_SPEC, P(COL_AXIS), P(ROW_AXIS)),
+        out_specs=P(ROW_AXIS),
+    )(csc_indptr, csc_rowidx, x8, undiscovered8)

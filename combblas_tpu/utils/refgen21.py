@@ -348,3 +348,101 @@ def graph500_edges(
         end_edge = nedges
     seed5 = make_mrg_seed(userseed, userseed)
     return generate_kronecker_range(seed5, scale, start_edge, end_edge)
+
+
+# --- native (C++) fast path -------------------------------------------------
+#
+# The reference's generator is native C; io/native/graphgen.cpp is this
+# module's native twin (same MRG/skip/scramble stream, threaded over
+# edges). graph500_edges_native builds it on demand and falls back to the
+# numpy implementation when no toolchain is available.
+
+_NATIVE_LIB = None
+_NATIVE_FAILED = False
+_NATIVE_LOCK = None
+
+
+def _load_native():
+    global _NATIVE_LIB, _NATIVE_FAILED, _NATIVE_LOCK
+    if _NATIVE_LIB is not None or _NATIVE_FAILED:
+        return _NATIVE_LIB
+    import ctypes
+    import os
+    import subprocess
+    import threading
+
+    if _NATIVE_LOCK is None:
+        _NATIVE_LOCK = threading.Lock()
+    with _NATIVE_LOCK:
+        if _NATIVE_LIB is not None or _NATIVE_FAILED:
+            return _NATIVE_LIB
+        return _load_native_locked(ctypes, os, subprocess)
+
+
+def _load_native_locked(ctypes, os, subprocess):
+    """Build+load under _NATIVE_LOCK (concurrent first calls must not race
+    the g++ build of the .so — same discipline as io/mm._load_native)."""
+    global _NATIVE_LIB, _NATIVE_FAILED
+    ndir = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "io", "native",
+    )
+    src = os.path.join(ndir, "graphgen.cpp")
+    so = os.path.join(ndir, "libgraphgen.so")
+    try:
+        if not os.path.exists(so) or os.path.getmtime(so) < os.path.getmtime(src):
+            subprocess.run(
+                ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+                 "-pthread", src, "-o", so],
+                check=True, capture_output=True,
+            )
+        lib = ctypes.CDLL(so)
+        lib.cbtpu_graph500_edges.restype = ctypes.c_int
+        lib.cbtpu_graph500_edges.argtypes = [
+            ctypes.c_uint64, ctypes.c_int, ctypes.c_int64, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int,
+        ]
+        _NATIVE_LIB = lib
+    except Exception:
+        _NATIVE_FAILED = True
+    return _NATIVE_LIB  # noqa: returned under the caller's lock
+
+
+def graph500_edges_native(
+    scale: int,
+    nedges: int | None = None,
+    userseed: int = 0xDECAFBAD,
+    edgefactor: int = 16,
+    start_edge: int = 0,
+    end_edge: int | None = None,
+    nthreads: int | None = None,
+) -> tuple[np.ndarray, np.ndarray]:
+    """``graph500_edges`` through the native generator (bit-identical;
+    threaded C++). Falls back to the numpy path without a toolchain."""
+    import ctypes
+    import os
+
+    if nedges is None:
+        nedges = edgefactor << scale
+    if end_edge is None:
+        end_edge = nedges
+    lib = _load_native()
+    if lib is None:
+        return graph500_edges(
+            scale, nedges, userseed, edgefactor, start_edge, end_edge
+        )
+    m = end_edge - start_edge
+    src = np.empty(m, np.int64)
+    dst = np.empty(m, np.int64)
+    if nthreads is None:
+        nthreads = min(os.cpu_count() or 1, 16)
+    rc = lib.cbtpu_graph500_edges(
+        ctypes.c_uint64(userseed), scale, start_edge, end_edge,
+        src.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        dst.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        nthreads,
+    )
+    if rc != 0:
+        raise ValueError(f"native generator failed (rc={rc})")
+    return src, dst

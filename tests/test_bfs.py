@@ -217,3 +217,42 @@ def test_bfs_batch_compact_ring_schedule():
     _, l1, _ = bfs_batch_compact(E, jnp.asarray(srcs))
     _, l2, _ = bfs_batch_compact(E, jnp.asarray(srcs), ring=True)
     np.testing.assert_array_equal(l1.to_global(), l2.to_global())
+
+
+@pytest.mark.parametrize("shape", [(1, 1), (2, 2)])
+def test_bfs_batch_compact_diropt_matches(shape):
+    """The union-frontier budgeted sparse regime (on-device lax.cond)
+    produces identical levels + valid trees vs the always-dense path."""
+    from combblas_tpu.models.bfs import bfs_batch_compact, validate_bfs_tree
+    from combblas_tpu.parallel.ellmat import EllParMat, build_csc_companion
+
+    rows, cols = rmat_symmetric_coo(jax.random.key(21), 8, 6)
+    n = 1 << 8
+    grid = Grid.make(*shape)
+    rr, cc = np.asarray(rows), np.asarray(cols)
+    E = EllParMat.from_host_coo(
+        grid, rr, cc, np.ones(len(rr), np.float32), n, n
+    )
+    csc = build_csc_companion(grid, rr, cc, n, n)
+    deg = np.bincount(rr, minlength=n)
+    srcs = np.flatnonzero(deg > 0)[[0, 3]].astype(np.int32)
+    _, l0, _ = bfs_batch_compact(E, jnp.asarray(srcs))
+    # small budgets: some levels sparse, some dense
+    p1, l1, _ = bfs_batch_compact(
+        E, jnp.asarray(srcs), csc=csc,
+        frontier_capacity=16, edge_capacity=256,
+    )
+    np.testing.assert_array_equal(l0.to_global(), l1.to_global())
+    # generous budgets: everything through the sparse kernel
+    p2, l2, _ = bfs_batch_compact(
+        E, jnp.asarray(srcs), csc=csc,
+        frontier_capacity=n, edge_capacity=4 * len(rr),
+    )
+    np.testing.assert_array_equal(l0.to_global(), l2.to_global())
+    d = np.zeros((n, n), bool)
+    d[rr, cc] = True
+    for k, s_ in enumerate(srcs):
+        assert not validate_bfs_tree(
+            d, int(s_), p1.to_global()[:, k],
+            l1.to_global().astype(np.int32)[:, k],
+        ), k

@@ -56,3 +56,29 @@ def test_edges_in_range():
     n = 1 << 9
     assert src.min() >= 0 and src.max() < n
     assert dst.min() >= 0 and dst.max() < n
+
+
+def test_native_generator_matches_numpy():
+    """The C++ generator is bit-identical to the numpy stream (which is
+    itself golden-tested against the reference generator)."""
+    from combblas_tpu.utils.refgen21 import (
+        _load_native,
+        graph500_edges_native,
+    )
+
+    if _load_native() is None:
+        import pytest
+
+        pytest.skip("no native toolchain")
+    for scale, M, seed in [(10, 64, 0xDECAFBAD), (8, 128, 0), (12, 32, 7)]:
+        s1, d1 = graph500_edges(scale, nedges=M, userseed=seed)
+        s2, d2 = graph500_edges_native(scale, nedges=M, userseed=seed,
+                                       nthreads=3)
+        np.testing.assert_array_equal(s1, s2)
+        np.testing.assert_array_equal(d1, d2)
+    # sub-range through the native path
+    full = graph500_edges_native(9, nedges=100, userseed=5)
+    part = graph500_edges_native(9, nedges=100, userseed=5,
+                                 start_edge=33, end_edge=77)
+    np.testing.assert_array_equal(part[0], full[0][33:77])
+    np.testing.assert_array_equal(part[1], full[1][33:77])
