@@ -1,0 +1,134 @@
+"""Application-level single-chip benchmarks: PageRank and triangle count.
+
+Same axon-safe protocol as bench.py (host build, one upload, one timed
+launch closed by a scalar readback). Prints one JSON line per app.
+
+APP=pagerank: K power iterations of the PLUS_TIMES ELL SpMV with teleport
+(the PageRank.cpp loop, :126-157) fused into one launch.
+APP=tc: L = tril(A); count = sum((L·L) .* L) — TC.cpp:104-116 — via the
+masked ESC SpGEMM.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+APP = os.environ.get("BENCH_APP", "pagerank")
+SCALE = int(os.environ.get("BENCH_SCALE", "18"))
+ITERS = int(os.environ.get("BENCH_ITERS", "16"))
+
+
+def _graph(scale, ef=16):
+    import numpy as np
+
+    from combblas_tpu.utils.refgen21 import graph500_edges_native
+
+    n = 1 << scale
+    src, dst = graph500_edges_native(scale, edgefactor=ef, userseed=11)
+    keep = src != dst
+    r = np.concatenate([src[keep], dst[keep]])
+    c = np.concatenate([dst[keep], src[keep]])
+    u = np.unique(r * np.int64(n) + c)
+    return (u // n).astype(np.int64), (u % n).astype(np.int64), n
+
+
+def bench_pagerank():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+
+    from combblas_tpu import PLUS_TIMES
+    from combblas_tpu.parallel.ellmat import EllParMat, dist_spmv_ell
+    from combblas_tpu.parallel.grid import Grid
+    from combblas_tpu.parallel.vec import DistVec
+
+    r, c, n = _graph(SCALE)
+    grid = Grid.make(1, 1)
+    deg = np.bincount(c, minlength=n).astype(np.float32)
+    # column-stochastic edge weights (out-degree normalization)
+    w = (1.0 / np.maximum(deg, 1.0))[c].astype(np.float32)
+    E = EllParMat.from_host_coo(grid, r, c, w, n, n)
+    x0 = DistVec.from_global(
+        grid, np.full(n, 1.0 / n, np.float32), align="col"
+    )
+
+    @jax.jit
+    def power(ell, xb):
+        def body(_, xb):
+            xv = DistVec(blocks=xb, length=n, align="col", grid=grid)
+            y = dist_spmv_ell(PLUS_TIMES, ell, xv)
+            yb = 0.85 * y.blocks + 0.15 / n
+            return DistVec(
+                blocks=yb, length=n, align="row", grid=grid
+            ).realign("col").blocks
+
+        return lax.fori_loop(0, ITERS, body, xb)
+
+    out = power(E, x0.blocks)
+    jax.block_until_ready(out)
+    time.sleep(3)
+    t0 = time.perf_counter()
+    out = power(E, x0.blocks)
+    _ = float(jax.device_get(out[0, 0]))
+    dt = time.perf_counter() - t0
+    nnz = len(r)
+    print(
+        json.dumps(
+            {
+                "metric": f"pagerank_rmat_scale{SCALE}_GFLOPs",
+                "value": round(nnz * 2 * ITERS / dt / 1e9, 3),
+                "unit": "GFLOP/s",
+                "ms_per_iter": round(dt / ITERS * 1e3, 2),
+                "nnz": nnz,
+                "iters": ITERS,
+            }
+        )
+    )
+
+
+def bench_tc():
+    import jax
+    import numpy as np
+
+    from combblas_tpu.models.tc import triangle_count
+    from combblas_tpu.parallel.grid import Grid
+    from combblas_tpu.parallel.spmat import SpParMat
+
+    r, c, n = _graph(SCALE, ef=8)
+    grid = Grid.make(1, 1)
+    A = SpParMat.from_global_coo(
+        grid, r, c, np.ones(len(r), np.float32), n, n
+    )
+    t = triangle_count(A)  # warmup/compile (host-orchestrated: sizes once)
+    n_tri = int(jax.device_get(t))
+    time.sleep(3)
+    t0 = time.perf_counter()
+    t = triangle_count(A)
+    n_tri = int(jax.device_get(t))
+    dt = time.perf_counter() - t0
+    print(
+        json.dumps(
+            {
+                "metric": f"tc_rmat_scale{SCALE}_s",
+                "value": round(dt, 2),
+                "unit": "s",
+                "triangles": n_tri,
+                "nnz": len(r),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    if APP == "pagerank":
+        bench_pagerank()
+    elif APP == "tc":
+        bench_tc()
+    else:
+        raise SystemExit(f"unknown BENCH_APP {APP}")
