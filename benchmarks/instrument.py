@@ -52,6 +52,8 @@ import os
 import sys
 import time
 
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
 SCALE = int(os.environ.get("BENCH_SCALE", "19"))
 EDGEFACTOR = int(os.environ.get("BENCH_EDGEFACTOR", "16"))
 
@@ -269,6 +271,259 @@ def exp_membw(mb: int, R: int):
     }
 
 
+def exp_scatter(variant: str, n_m: float, t_m: float, R: int):
+    """Scatter/gather throughput probe — the SpGEMM-redesign question.
+
+    N million values are scattered into a T-million-cell table R times in
+    one launch. Variants:
+      add         .at[idx].add, random unsorted indices
+      min         .at[idx].min int32, random unsorted
+      addsort     .at[idx].add, SORTED indices + indices_are_sorted hint
+      segsum      jax.ops.segment_sum, sorted ids, NO hint (today's
+                  segment_reduce path)
+      segsumhint  segment_sum, sorted ids, indices_are_sorted=True
+      gather      x[idx] baseline (known ~133M idx/s)
+    Distinguishes the two contradictory round-2 scatter numbers (79 ms for
+    22.6M row-scatter vs '0.2us/element') and prices the bucketed-
+    accumulation SpGEMM before building it.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+
+    N = int(n_m * 1e6)
+    T = int(t_m * 1e6)
+    rng = np.random.default_rng(0)
+    idx_np = rng.integers(0, T, size=N, dtype=np.int32)
+    if variant in ("addsort", "segsum", "segsumhint"):
+        idx_np = np.sort(idx_np)
+    idx = jax.device_put(jnp.asarray(idx_np))
+    vals = jax.device_put(jnp.ones((N,), jnp.float32))
+
+    if variant == "add":
+
+        def op(idx, vals, s):
+            t = jnp.zeros((T,), jnp.float32)
+            return t.at[idx].add(vals + s * 1e-30, mode="drop")
+
+    elif variant == "min":
+
+        def op(idx, vals, s):
+            t = jnp.full((T,), jnp.int32(2**31 - 1))
+            return t.at[idx].min(
+                jnp.arange(N, dtype=jnp.int32) + (s * 0).astype(jnp.int32),
+                mode="drop",
+            ).astype(jnp.float32)
+
+    elif variant == "addsort":
+
+        def op(idx, vals, s):
+            t = jnp.zeros((T,), jnp.float32)
+            return t.at[idx].add(
+                vals + s * 1e-30, mode="drop", indices_are_sorted=True
+            )
+
+    elif variant == "segsum":
+
+        def op(idx, vals, s):
+            return jax.ops.segment_sum(
+                vals + s * 1e-30, idx, num_segments=T
+            )
+
+    elif variant == "segsumhint":
+
+        def op(idx, vals, s):
+            return jax.ops.segment_sum(
+                vals + s * 1e-30, idx, num_segments=T,
+                indices_are_sorted=True,
+            )
+
+    elif variant == "gather":
+
+        def op(idx, vals, s):
+            x = vals + s * 1e-30
+            pad = jnp.zeros((T,), jnp.float32).at[: min(N, T)].set(x[: min(N, T)])
+            return pad[idx][:T]
+
+    else:
+        raise SystemExit(f"unknown scatter variant {variant}")
+
+    @jax.jit
+    def run(idx, vals):
+        def body(_, s):
+            out = op(idx, vals, s)
+            return out[0] + s * 1e-30
+
+        return lax.fori_loop(0, R, body, jnp.float32(0))
+
+    out = run(idx, vals)
+    jax.block_until_ready(out)
+    time.sleep(3.0)
+    dt = timed(lambda prev: run(idx, vals), 1,
+               lambda out: float(jax.device_get(out)))
+    return {
+        "experiment": f"scatter {variant} N={n_m}M T={t_m}M R={R}",
+        "dt_s": round(dt, 4),
+        "ms_per_iter": round(dt / R * 1e3, 3),
+        "Melem_per_s": round(N * R / dt / 1e6, 1),
+        "ns_per_elem": round(dt / (N * R) * 1e9, 2),
+    }
+
+
+def _build_local_esc(scale: int, ef: int = 8):
+    """Local A (SpTuples, row-sorted) + A as CSR + exact capacities for A^2."""
+    import jax
+    import numpy as np
+
+    from combblas_tpu.ops.compressed import CSR
+    from combblas_tpu.ops.tuples import SpTuples
+    from combblas_tpu.utils.rmat import rmat_symmetric_coo_host
+
+    n = 1 << scale
+    rows, cols = rmat_symmetric_coo_host(5, scale, ef)
+    key = rows * np.int64(n) + cols
+    uniq = np.unique(key)
+    ru = (uniq // n).astype(np.int64)
+    cu = (uniq % n).astype(np.int64)
+    nnz = len(ru)
+    # exact flops on host: sum over entries of rowlen[col]
+    rowlen = np.bincount(ru, minlength=n)
+    flops = int(rowlen[cu].sum())
+    a = SpTuples.from_coo(ru, cu, np.ones(nnz, np.float32), n, n)
+    csr = CSR.from_tuples(a, assume_sorted=True)
+    return a, csr, n, nnz, flops
+
+
+def exp_escparts(variant: str, scale: int, R: int):
+    """Decompose local ESC SpGEMM (A^2, rmat ef8) phase by phase:
+      expand / sort / segsum / compact / full — each timed alone in one
+      launch chain. Identifies which of the 26.6 s at scale 14 is sort,
+      which is the segment scatter, which is compaction scatters.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from combblas_tpu import PLUS_TIMES
+    from combblas_tpu.ops.spgemm import expand
+    from combblas_tpu.ops.tuples import SpTuples
+
+    sr = PLUS_TIMES
+    a, csr, n, nnz, flops = _build_local_esc(scale)
+    fcap = flops  # exact
+    ocap = flops  # generous; compact clamps
+
+    exp_t = None
+    if variant in ("sort", "segsum", "compact"):
+        # materialize the expansion once (untimed) as the phase input
+        exp_t = jax.jit(
+            lambda a, c: expand(sr, a, c, fcap), static_argnums=()
+        )(a, csr)
+        jax.block_until_ready(exp_t.vals)
+
+    if variant == "expand":
+
+        @jax.jit
+        def run(a, csr):
+            def body(_, s):
+                import dataclasses
+
+                t = expand(
+                    sr,
+                    dataclasses.replace(a, vals=a.vals + s * 1e-30),
+                    csr,
+                    fcap,
+                )
+                return t.vals[0] + s * 1e-30
+
+            return lax.fori_loop(0, R, body, jnp.float32(0))
+
+        args = (a, csr)
+    elif variant == "sort":
+
+        @jax.jit
+        def run(t):
+            def body(_, s):
+                import dataclasses
+
+                st = dataclasses.replace(t, vals=t.vals + s * 1e-30)
+                st = st.sort_rowmajor()
+                return st.vals[0] + s * 1e-30
+
+            return lax.fori_loop(0, R, body, jnp.float32(0))
+
+        args = (exp_t,)
+    elif variant == "segsum":
+        # sorted expansion -> the segment fold + scatters of compact_counted
+        # WITHOUT the sort (assume_sorted) — isolates the post-sort phases
+        exp_t = jax.jit(lambda t: t.sort_rowmajor())(exp_t)
+        jax.block_until_ready(exp_t.vals)
+
+        @jax.jit
+        def run(t):
+            def body(_, s):
+                import dataclasses
+
+                st = dataclasses.replace(t, vals=t.vals + s * 1e-30)
+                out, _ = st.compact_counted(
+                    sr, capacity=ocap, assume_sorted=True
+                )
+                return out.vals[0] + s * 1e-30
+
+            return lax.fori_loop(0, R, body, jnp.float32(0))
+
+        args = (exp_t,)
+    elif variant == "compact":
+
+        @jax.jit
+        def run(t):
+            def body(_, s):
+                import dataclasses
+
+                st = dataclasses.replace(t, vals=t.vals + s * 1e-30)
+                out, _ = st.compact_counted(sr, capacity=ocap)
+                return out.vals[0] + s * 1e-30
+
+            return lax.fori_loop(0, R, body, jnp.float32(0))
+
+        args = (exp_t,)
+    elif variant == "full":
+
+        @jax.jit
+        def run(a, csr):
+            def body(_, s):
+                import dataclasses
+
+                from combblas_tpu.ops.spgemm import local_spgemm
+
+                aa = dataclasses.replace(a, vals=a.vals + s * 1e-30)
+                C = local_spgemm(
+                    sr, aa, csr, flop_capacity=fcap, out_capacity=ocap
+                )
+                return C.vals[0] + s * 1e-30
+
+            return lax.fori_loop(0, R, body, jnp.float32(0))
+
+        args = (a, csr)
+    else:
+        raise SystemExit(f"unknown escparts variant {variant}")
+
+    out = run(*args)
+    jax.block_until_ready(out)
+    time.sleep(3.0)
+    dt = timed(lambda prev: run(*args), 1,
+               lambda out: float(jax.device_get(out)))
+    return {
+        "experiment": f"escparts {variant} scale={scale} R={R}",
+        "dt_s": round(dt, 4),
+        "s_per_iter": round(dt / R, 3),
+        "nnz": nnz,
+        "flops": flops,
+        "MFLOPs": round(flops * 2 * R / dt / 1e6, 2),
+    }
+
+
 def main():
     exp = sys.argv[1]
     if exp == "chain":
@@ -290,6 +545,13 @@ def main():
         out = exp_sort(int(sys.argv[2]), int(sys.argv[3]))
     elif exp == "argsort":
         out = exp_argsort(int(sys.argv[2]), int(sys.argv[3]))
+    elif exp == "scatter":
+        out = exp_scatter(
+            sys.argv[2], float(sys.argv[3]), float(sys.argv[4]),
+            int(sys.argv[5]),
+        )
+    elif exp == "escparts":
+        out = exp_escparts(sys.argv[2], int(sys.argv[3]), int(sys.argv[4]))
     else:
         raise SystemExit(f"unknown experiment {exp}")
     out["scale"] = SCALE

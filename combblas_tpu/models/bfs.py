@@ -390,13 +390,15 @@ def batch_traversed_edges(deg_row_blocks, parents) -> jax.Array:
     padding 0); ``parents``: the DistMultiVec from ``bfs_batch``.
     """
     disc = parents.blocks >= 0  # [pr, lr, W]
-    # int32 accumulation: per-root traversed edges <= nnz, which stays below
-    # 2^31 through scale 26 at edgefactor 16 — the single-chip regime.
+    # uint32 accumulation: a giant component's per-root degree sum can reach
+    # the full symmetrized endpoint count ~2^(scale+5) at edgefactor 16,
+    # which crosses 2^31 near scale 26 — uint32 extends the safe range to
+    # scale ~27 (the [W] output is tiny, so width costs nothing).
     te = jnp.sum(
-        jnp.where(disc, deg_row_blocks[:, :, None], 0).astype(jnp.int32),
+        jnp.where(disc, deg_row_blocks[:, :, None], 0).astype(jnp.uint32),
         axis=(0, 1),
     )
-    return te // 2
+    return (te // 2).astype(jnp.int32)
 
 
 @partial(

@@ -102,9 +102,12 @@ def densify(t: SpTuples, pad_rows: int, pad_cols: int, zero) -> Array:
     row-major sortable), which XLA can turn into a vectorized store.
     """
     t = t.sort_rowmajor()
-    flat = jnp.where(
-        t.valid_mask(), t.rows * pad_cols + t.cols, pad_rows * pad_cols
-    )
+    # Invalid slots get DISTINCT out-of-bounds indices (base + slot id) so
+    # the unique_indices contract holds even for padding; mode='drop'
+    # discards them all. Sortedness survives: valid entries occupy an
+    # ascending prefix below base, invalid tail slots get base + position.
+    oob = pad_rows * pad_cols + jnp.arange(t.capacity, dtype=jnp.int32)
+    flat = jnp.where(t.valid_mask(), t.rows * pad_cols + t.cols, oob)
     dense = jnp.full((pad_rows * pad_cols,), zero, t.vals.dtype)
     dense = dense.at[flat].set(
         t.vals, mode="drop", indices_are_sorted=True, unique_indices=True

@@ -189,7 +189,11 @@ class SpTuples:
         )
 
     def compact_counted(
-        self, sr: Semiring, *, capacity: int | None = None
+        self,
+        sr: Semiring,
+        *,
+        capacity: int | None = None,
+        assume_sorted: bool = False,
     ) -> tuple["SpTuples", Array]:
         """``compact`` that also returns the EXACT distinct-key count
         (before any truncation) — the per-tile role of the reference's
@@ -207,9 +211,12 @@ class SpTuples:
         static-shape price of XLA — callers size capacities from symbolic
         estimates, see ops/spgemm.py). ``nnz`` is clamped to ``capacity`` so
         the result stays self-consistent either way.
+
+        ``assume_sorted=True`` skips the row-major sort (caller guarantees
+        slots are already (row, col)-sorted with padding at the tail).
         """
         cap = capacity if capacity is not None else self.capacity
-        t = self.sort_rowmajor()
+        t = self if assume_sorted else self.sort_rowmajor()
         valid = t.valid_mask()
         prev_same = jnp.concatenate(
             [

@@ -448,7 +448,8 @@ def _ell_levels_step(E: EllParMat, x8, undiscovered8, ring: bool = False):
         y = jnp.minimum(y, ublk[0])  # only undiscovered rows fire
         if ring:
             # the carousel schedule: neighbor ppermute rotation over the
-            # row communicator instead of the fused all-reduce
+            # 'c' mesh axis (COL_AXIS — same axis the pmax path reduces)
+            # instead of the fused all-reduce
             from ..semiring import SELECT2ND_MAX
             from .collectives import axis_ring_reduce
 

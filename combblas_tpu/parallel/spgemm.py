@@ -319,17 +319,38 @@ def mem_efficient_spgemm(
     O(output)-memory profile of the reference's hash path.
     """
     lc = B.local_cols
-    splittable = B.ncols == lc * B.grid.pc and lc % max(phases, 1) == 0
-    if phases > 1 and not splittable:
+    if phases > 1 and B.ncols != lc * B.grid.pc:
+        # An irregular (padded) column distribution cannot be phase-split;
+        # silently unphasing would blow the caller's memory budget, so fail
+        # loudly with guidance (reference phase contract: ParFriends.h:450).
+        raise ValueError(
+            f"mem_efficient_spgemm: ncols={B.ncols} is not evenly "
+            f"distributed over pc={B.grid.pc} (local_cols={lc}); pad the "
+            "matrix to a multiple of pc or run with phases=1"
+        )
+    if phases > 1 and lc % phases:
+        # Nearest divisor >= requested keeps every phase AT MOST the size
+        # the caller budgeted for (more phases = smaller phases = safe) —
+        # but only within 4x, so a divisor-poor lc (e.g. prime) fails
+        # loudly instead of silently multiplying the SUMMA pass count.
+        adj = min(phases, lc)
+        while adj <= lc and lc % adj:
+            adj += 1
+        if adj > 4 * phases:
+            raise ValueError(
+                f"mem_efficient_spgemm: {phases} phases does not divide "
+                f"local_cols={lc} and the nearest divisor above it ({adj}) "
+                "is >4x the request; choose a phase count dividing "
+                f"local_cols (divisors of {lc}) or repad the matrix"
+            )
         import warnings
 
         warnings.warn(
-            f"mem_efficient_spgemm: ncols={B.ncols} not splittable into "
-            f"{phases} phases on a {B.grid.pr}x{B.grid.pc} grid "
-            "(needs ncols % (pc * phases) == 0); running unphased",
+            f"mem_efficient_spgemm: {phases} phases does not divide "
+            f"local_cols={lc}; using the nearest divisor {adj} instead",
             stacklevel=2,
         )
-        phases = 1
+        phases = adj
     mult = (
         (lambda a, b: spgemm_scan(sr, a, b, slack=slack))
         if scan
@@ -409,9 +430,13 @@ def calculate_phases(
     peak = per_stage.max() * p * slot_bytes * slack
     phases = max(1, int(np.ceil(peak / max(per_device_memory_bytes, 1))))
     phases = 1 << (phases - 1).bit_length()
-    # Clamp to a divisor of B's local column count — a non-divisor would
-    # make mem_efficient_spgemm fall back to unphased, defeating the budget.
     lc = B.local_cols
+    if B.ncols != lc * B.grid.pc:
+        # Irregular (padded) column distribution cannot be phase-split —
+        # mem_efficient_spgemm rejects phases>1 there, so don't request it.
+        return 1
+    # Clamp to a divisor of B's local column count — mem_efficient_spgemm
+    # only accepts divisors (it adjusts upward within 4x, errors beyond).
     phases = min(phases, max(lc, 1))
     while phases > 1 and lc % phases:
         phases >>= 1

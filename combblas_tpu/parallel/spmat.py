@@ -65,9 +65,16 @@ def _monotone_key_u32(v: Array) -> Array:
     dtype = jnp.dtype(v.dtype)
     if dtype == jnp.bool_:
         return v.astype(jnp.uint32)
+    if dtype.itemsize < 4 and jnp.issubdtype(dtype, jnp.integer):
+        # Sub-32-bit ints widen losslessly; the sign-XOR below then applies
+        # in 32-bit key space.
+        v = v.astype(
+            jnp.int32 if jnp.issubdtype(dtype, jnp.signedinteger) else jnp.uint32
+        )
+        dtype = jnp.dtype(v.dtype)
     assert dtype.itemsize in (4, 8), (
-        f"kselect supports 32/64-bit dtypes, got {dtype} (cast bf16/f16 "
-        "values to float32 first)"
+        f"kselect supports integer and 32/64-bit dtypes, got {dtype} (cast "
+        "bf16/f16 values to float32 first)"
     )
     wide = dtype.itemsize == 8
     ut = jnp.uint64 if wide else jnp.uint32
@@ -97,7 +104,11 @@ def _u32_key_to_val(key: Array, dtype) -> Array:
         mask = jnp.where((key >> shift) != 0, sign, allbits)
         return lax.bitcast_convert_type(key ^ mask, dtype)
     if jnp.issubdtype(dtype, jnp.signedinteger):
-        return lax.bitcast_convert_type(key ^ sign, dtype)
+        # Sub-32-bit ints were widened by _monotone_key_u32: bitcast back to
+        # the matching-width signed type first, then narrow (a direct
+        # bitcast to int8/int16 would add a trailing byte axis).
+        it = jnp.int64 if wide else jnp.int32
+        return lax.bitcast_convert_type(key ^ sign, it).astype(dtype)
     return key.astype(dtype)
 
 

@@ -145,6 +145,28 @@ def test_mem_efficient_spgemm_matches_plain(rng, phases):
     np.testing.assert_allclose(plain, da @ db, rtol=1e-5, atol=1e-6)
 
 
+def test_mem_efficient_spgemm_nondivisor_phase_adjust(rng):
+    """A non-divisor phase count is adjusted to the nearest divisor >= it
+    (still honoring the memory budget), never silently unphased."""
+    grid = Grid.make(2, 2)
+    da = random_dense(rng, 16, 16, 0.3)
+    A = SpParMat.from_dense(grid, da)  # local_cols = 8
+    with pytest.warns(UserWarning, match="nearest divisor"):
+        # 3 does not divide 8 -> adjusted to 4
+        phased = mem_efficient_spgemm(PLUS_TIMES, A, A, 3).to_dense()
+    np.testing.assert_allclose(phased, da @ da, rtol=1e-5, atol=1e-6)
+
+
+def test_mem_efficient_spgemm_irregular_distribution_errors(rng):
+    grid = Grid.make(2, 2)
+    da = random_dense(rng, 10, 9, 0.4)  # 9 % pc != 0 -> padded dist
+    A = SpParMat.from_dense(grid, da)
+    if A.ncols == A.local_cols * grid.pc:
+        pytest.skip("distribution is regular on this grid")
+    with pytest.raises(ValueError, match="phases=1"):
+        mem_efficient_spgemm(PLUS_TIMES, A, A, 2)
+
+
 def test_make_col_stochastic_and_chaos(rng):
     grid = Grid.make(2, 2)
     d = np.abs(random_dense(rng, 12, 12, 0.5)) + 0.0

@@ -188,6 +188,28 @@ def test_kselect2_parity(rng):
     assert not bool(none_active)
 
 
+def test_kselect_small_int_dtypes(rng):
+    """Sub-32-bit integer values widen to 32-bit keys (kselect supported
+    int8/16 via astype fallthrough before the round-2 assert; regression
+    coverage for the widening path)."""
+    grid = Grid.make(2, 2)
+    n = 32
+    for dt in (np.int8, np.int16, np.uint8):
+        d = ((rng.random((n, n)) < 0.4) * rng.integers(1, 100, (n, n))).astype(dt)
+        if np.issubdtype(dt, np.signedinteger):
+            d = (d * np.where(rng.random((n, n)) < 0.5, -1, 1)).astype(dt)
+        A = SpParMat.from_dense(grid, d)
+        th = np.asarray(A.kselect(3).realign("col").blocks).reshape(-1)[:n]
+        assert th.dtype == dt
+        lo = np.iinfo(dt).min if np.issubdtype(dt, np.signedinteger) else 0
+        ref = np.full(n, lo, np.int64)
+        for j in range(n):
+            nz = np.sort(d[:, j][d[:, j] != 0].astype(np.int64))[::-1]
+            if len(nz) >= 3:
+                ref[j] = nz[2]
+        np.testing.assert_array_equal(th.astype(np.int64), ref)
+
+
 def test_block_split(rng):
     """BlockSplit (SpParMat.cpp:2974): 2D submatrix grid, reassembled."""
     grid = Grid.make(2, 2)
