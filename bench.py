@@ -1,42 +1,68 @@
 """Graph500 BFS benchmark on the real TPU chip.
 
 Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": "MTEPS", "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": "MTEPS", "vs_baseline": N, ...}
 
 Protocol (adapted from the reference's TopDownBFS driver,
 TopDownBFS.cpp:421-479): R-MAT scale-S graph (edgefactor 16, symmetrized,
 deloop'd, dedup'd), BFS from NROOTS random reachable roots, AGGREGATE MTEPS
-over the batch (sum of kernel-2 traversed edges / total wall time).
-NOTE: the Graph500 spec and the archived baseline use harmonic-mean
-per-root TEPS; per-root timing needs per-launch sync, which this device
-does not provide trustworthily, so the aggregate — which amortizes launch
-overhead across roots — is reported instead, with that caveat.
+over the batch (sum of kernel-2 traversed edges / total wall time), plus an
+amortized per-root harmonic-mean decomposition (see below).
+
+VARIANCE CONTROL (round 3 — the round-2 driver capture measured 46.98
+MTEPS where the builder's sweep measured 297.0 with identical config, a
+6.3x run-to-run swing): the benchmark now runs BENCH_REPEATS (default 3)
+INDEPENDENT SUBPROCESS repeats — process isolation is mandatory because on
+this chip any device->host readback permanently degrades later launches in
+that process (see below), so in-process repeats after the first timed
+readback measure a poisoned runtime.  The parent builds the graph once,
+ships it to children via an .npz, collects each child's JSON, and reports
+the MEDIAN with all per-repeat values recorded.  Each child also:
+  * uses a LONG warm drain (BENCH_DRAIN_S, default 45 s) — the round-2
+    default of 5 s did not cover the warmup launch's ~20-30 s EXECUTION
+    (block_until_ready through the tunnel returns early), so a cold or
+    slow run could overlap leftover warmup execution into the timed
+    window — the leading suspect for the 6.3x;
+  * records warmup_s (compile + first execution) so a cold compile cache
+    is visible in the artifact;
+  * warns (field "warning") when its MTEPS lands >2x below the recorded
+    operating point (297 MTEPS at scale 20 / W=256).
 
 DESIGN (round 2, from the measured probe decomposition in
 benchmarks/results/instrument_r2_raw*.txt):
   * per-launch dispatch through the axon tunnel costs ~105 ms regardless
     of resident argument bytes → the WHOLE batch is ONE launch;
-  * the ELL SpMV kernel is gather-bound at ~130M indices/s, and a gather's
-    cost is per-INDEX: fetching W=64 payload lanes costs only ~2x one lane
-    (gatherw probes) → all NROOTS=64 BFS trees advance together as one
-    [n, 64] frontier matrix (bfs_batch; SURVEY §2.3 strategy 7), so the
-    per-index cost is split 64 ways;
+  * the ELL SpMV kernel is gather-bound (~130M idx/s small-table) and a
+    gather's cost is per-INDEX: all NROOTS roots advance together as one
+    [n, W] frontier matrix (bfs_batch; SURVEY §2.3 strategy 7);
   * kernel-2 TEPS accounting runs on device (batch_traversed_edges); the
     only D2H is one [W] vector + the sync scalar, AFTER timing;
-  * the search loop carries int8 LEVEL indicators (1 byte/root per
-    gathered index instead of 4) and reconstructs parents in one final
-    sweep (bfs_batch_compact) — the gather is payload-width sensitive
-    above ~256B/index, so the byte-wide frontier cuts dense-level cost
-    further and halves HBM state.
-Operating point (measured sweep, benchmarks/results/bench_sweep_r2*.txt):
-scale 20 x 256 roots = 217.8 MTEPS; W=384+ exceeds the 16G HBM at scale 20,
-W=512 at scale 19 also OOMs; scale 21 x 256 OOMs. Round-1 single-root
-per-launch design measured 3.32 MTEPS — this is 65x.
+  * int8 LEVEL indicators + one-pass parent reconstruction
+    (bfs_batch_compact) halve HBM state.
+
+PER-ROOT STATISTIC: the Graph500 spec reports harmonic-mean per-root
+TEPS.  Per-root timing needs per-launch sync, which this device does not
+provide trustworthily; instead the batch time is decomposed under the
+equal-share model (every level's gather serves all W roots at once, so
+each root's attributed time is dt/W): TEPS_r = te_r * W / dt, and over
+the n_live reachable roots
+  harmonic_mean_MTEPS = n_live * W / (dt * sum(1/te_r)) / 1e6.
+This amortization is a real property of the batched design (the chip does
+serve W roots per gather), but it is NOT the spec's sequential-root
+statistic; both numbers are reported.
+
+KERNEL 1: graph construction is timed (construction_s in the JSON: host
+R-MAT + dedup + ELL bucketing + upload).  The fully-distributed device
+composition of kernel 1 (generate → all_to_all route → dedup →
+relabel → isolated-compression, models/graph500.py:kernel1_device) is
+exercised by __graft_entry__.dryrun_multichip and tests/test_graph500.py;
+it is not used here because its sizing readbacks would poison the timed
+BFS launches in the same process (readback note below).
 
 AXON D2H NOTE: this chip's runtime permanently degrades launch performance
-(~1000x) after ANY device->host readback, so the pipeline is strictly
-phased: (1) host-numpy graph construction + ELL bucketing, (2) one upload,
-(3) ONE timed launch closed by the te readback (the only reliable sync).
+(~1000x) after ANY device->host readback, so each child is strictly
+phased: (1) host graph load + ELL bucketing, (2) one upload, (3) ONE
+timed launch closed by the te readback (the only reliable sync).
 
 vs_baseline compares single-chip MTEPS against the smallest archived
 reference run: 1,636 MTEPS on 1,024 Hopper (Cray XE6) cores
@@ -47,17 +73,51 @@ from __future__ import annotations
 
 import json
 import os
+import subprocess
+import sys
+import tempfile
 import time
 
 SCALE = int(os.environ.get("BENCH_SCALE", "20"))
 EDGEFACTOR = int(os.environ.get("BENCH_EDGEFACTOR", "16"))
 NROOTS = int(os.environ.get("BENCH_NROOTS", "256"))
-DIROPT = os.environ.get("BENCH_DIROPT", "0") == "1"  # union-frontier sparse
-# levels (budgets below); measured configuration notes in PERF_NOTES_r2.md
+DIROPT = os.environ.get("BENCH_DIROPT", "0") == "1"
+REPEATS = int(os.environ.get("BENCH_REPEATS", "3"))
+DRAIN_S = float(os.environ.get("BENCH_DRAIN_S", "45"))
 BASELINE_MTEPS = 1636.0  # Hopper 1024 cores, R-MAT "mini"
+OPERATING_MTEPS = 297.0  # recorded sweep at scale 20 / W=256 (r2h)
 
 
-def main():
+def build_graph_npz(path: str) -> float:
+    """Kernel 1, host path: R-MAT generate + symmetricize + dedup; returns
+    construction seconds (graph build only; per-child ELL bucketing and
+    upload are timed separately as construction_child_s)."""
+    import numpy as np
+
+    from combblas_tpu.utils.rmat import rmat_symmetric_coo_host
+
+    t0 = time.perf_counter()
+    n = 1 << SCALE
+    rows, cols = rmat_symmetric_coo_host(42, SCALE, EDGEFACTOR)
+    key = rows * np.int64(n) + cols
+    uniq = np.unique(key)
+    rows_u = (uniq // n).astype(np.int64)
+    cols_u = (uniq % n).astype(np.int64)
+    deg = np.bincount(rows_u, minlength=n)
+    dt = time.perf_counter() - t0
+    rng = np.random.default_rng(7)
+    roots = rng.choice(np.flatnonzero(deg > 0), size=NROOTS, replace=False)
+    np.savez(
+        path,
+        rows=rows_u.astype(np.int32),  # scale <= 31 fits; halves the file
+        cols=cols_u.astype(np.int32),
+        deg=deg.astype(np.int32),
+        roots=roots.astype(np.int32),
+    )
+    return dt
+
+
+def child(graph_path: str):
     import jax
     import numpy as np
 
@@ -65,22 +125,16 @@ def main():
     from combblas_tpu.parallel.ellmat import EllParMat
     from combblas_tpu.parallel.grid import Grid
     from combblas_tpu.parallel.vec import DistVec
-    from combblas_tpu.utils.rmat import rmat_symmetric_coo_host
 
     grid = Grid.make(1, 1)
     n = 1 << SCALE
 
-    # --- Phase 1: host-only construction ---------------------------------
-    rows, cols = rmat_symmetric_coo_host(42, SCALE, EDGEFACTOR)
-    key = rows * np.int64(n) + cols
-    uniq = np.unique(key)
-    rows_u = (uniq // n).astype(np.int64)
-    cols_u = (uniq % n).astype(np.int64)
-    deg = np.bincount(rows_u, minlength=n)
+    # --- Phase 1: host-only load + bucketing ------------------------------
+    t0 = time.perf_counter()
+    data = np.load(graph_path)
+    rows_u, cols_u = data["rows"], data["cols"]
+    deg, roots = data["deg"], data["roots"]
     nnz = len(rows_u)
-
-    rng = np.random.default_rng(7)
-    roots = rng.choice(np.flatnonzero(deg > 0), size=NROOTS, replace=False)
 
     # --- Phase 2: upload (H2D only) ---------------------------------------
     E = EllParMat.from_host_coo(
@@ -94,44 +148,119 @@ def main():
         csc = build_csc_companion(grid, rows_u, cols_u, n, n)
         fcap = grid.local_cols(n) // 8
         ecap = max(nnz // 16, 1 << 20)
-    deg_blocks = DistVec.from_global(
-        grid, deg.astype(np.int32), align="row"
-    ).blocks
+    deg_blocks = DistVec.from_global(grid, deg, align="row").blocks
     roots_dev = jax.device_put(np.asarray(roots, np.int32))
+    construction_child_s = time.perf_counter() - t0
 
     # --- Phase 3: ONE timed launch ----------------------------------------
-    # Warmup compiles the whole batched program; block_until_ready is not a
-    # reliable barrier through the tunnel, so sleep covers the drain and the
-    # timed section is closed by the te readback (its ~5 ms inflates dt,
-    # biasing reported TEPS DOWN).
+    # Warmup compiles AND executes the whole batched program.
+    # block_until_ready is not a reliable barrier through the tunnel, so
+    # the drain sleep must cover the warmup EXECUTION (~20-30 s at the
+    # operating point), not just dispatch — hence DRAIN_S=45 default.
+    t0 = time.perf_counter()
     p, _, _ = bfs_batch_compact(
         E, roots_dev, csc=csc, frontier_capacity=fcap, edge_capacity=ecap
     )
     te_dev = batch_traversed_edges(deg_blocks, p)
     jax.block_until_ready(te_dev)
-    time.sleep(5.0)
+    warmup_s = time.perf_counter() - t0
+    time.sleep(DRAIN_S)
 
     t0 = time.perf_counter()
     parents, _, _ = bfs_batch_compact(
         E, roots_dev, csc=csc, frontier_capacity=fcap, edge_capacity=ecap
     )
     te_dev = batch_traversed_edges(deg_blocks, parents)
-    te = np.asarray(jax.device_get(te_dev))  # true barrier
-    dt_total = time.perf_counter() - t0
+    te = np.asarray(jax.device_get(te_dev))  # true barrier (poisons after)
+    dt = time.perf_counter() - t0
 
     # --- Phase 4: accounting ----------------------------------------------
-    total_te = int(te.sum())
-    mteps = total_te / dt_total / 1e6
-    print(
-        json.dumps(
-            {
-                "metric": f"graph500_bfs_rmat_scale{SCALE}_1chip_MTEPS",
-                "value": round(mteps, 2),
-                "unit": "MTEPS",
-                "vs_baseline": round(mteps / BASELINE_MTEPS, 4),
-            }
-        )
+    total_te = int(te.astype(np.int64).sum())
+    W = len(te)
+    mteps = total_te / dt / 1e6
+    live = te[te > 0].astype(np.float64)
+    hm = (
+        (len(live) * W / (dt * np.sum(1.0 / live)) / 1e6)
+        if len(live) else 0.0
     )
+    out = {
+        "mteps": round(mteps, 2),
+        "harmonic_mean_amortized_mteps": round(float(hm), 2),
+        "dt_s": round(dt, 3),
+        "warmup_s": round(warmup_s, 2),
+        "drain_s": DRAIN_S,
+        "total_traversed_edges": total_te,
+        "roots": int(W),
+        "reachable_roots": int((te > 0).sum()),
+        "construction_child_s": round(construction_child_s, 2),
+    }
+    if mteps < OPERATING_MTEPS / 2 and SCALE == 20 and NROOTS == 256:
+        out["warning"] = (
+            f"{mteps:.1f} MTEPS is >2x below the recorded operating point "
+            f"({OPERATING_MTEPS}); suspect drain/compile-cache/chip state"
+        )
+    print(json.dumps(out))
+
+
+def main():
+    if os.environ.get("BENCH_CHILD"):
+        child(os.environ["BENCH_GRAPH_NPZ"])
+        return
+
+    import shutil
+
+    tmp = tempfile.mkdtemp(prefix="bench_g500_")
+    try:
+        graph_path = os.path.join(tmp, "graph.npz")
+        construction_s = build_graph_npz(graph_path)
+
+        runs = []
+        for i in range(max(REPEATS, 1)):
+            env = dict(os.environ)
+            env["BENCH_CHILD"] = "1"
+            env["BENCH_GRAPH_NPZ"] = graph_path
+            try:
+                r = subprocess.run(
+                    [sys.executable, os.path.abspath(__file__)],
+                    capture_output=True, text=True, env=env,
+                    cwd=os.path.dirname(os.path.abspath(__file__)),
+                    timeout=float(os.environ.get("BENCH_CHILD_TIMEOUT", "1800")),
+                )
+                line = (r.stdout.strip().splitlines() or [""])[-1]
+                stderr_tail = (r.stderr.strip().splitlines() or ["no output"])[-1]
+            except subprocess.TimeoutExpired:
+                line, stderr_tail = "", "child timeout (wedged launch?)"
+            try:
+                runs.append(json.loads(line))
+            except json.JSONDecodeError:
+                runs.append({"mteps": 0.0, "error": stderr_tail})
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    ok = sorted(
+        (r for r in runs if r.get("mteps", 0) > 0), key=lambda r: r["mteps"]
+    )
+    # median REPEAT: value and the per-root statistic come from the same run
+    med_run = ok[(len(ok) - 1) // 2] if ok else {}
+    median = med_run.get("mteps", 0.0)
+    out = {
+        "metric": f"graph500_bfs_rmat_scale{SCALE}_1chip_MTEPS",
+        "value": round(median, 2),
+        "unit": "MTEPS",
+        "vs_baseline": round(median / BASELINE_MTEPS, 4),
+        "repeats_mteps": [r.get("mteps", 0.0) for r in runs],
+        "harmonic_mean_amortized_mteps": med_run.get(
+            "harmonic_mean_amortized_mteps", 0.0
+        ),
+        "construction_s": round(construction_s, 2),
+        "runs": runs,
+    }
+    if median < OPERATING_MTEPS / 2 and SCALE == 20 and NROOTS == 256:
+        out["warning"] = (
+            f"median {median:.1f} MTEPS >2x below operating point "
+            f"{OPERATING_MTEPS}; see per-run diagnostics in 'runs'"
+        )
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":
