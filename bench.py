@@ -167,12 +167,40 @@ def child(graph_path: str):
     time.sleep(DRAIN_S)
 
     t0 = time.perf_counter()
-    parents, _, _ = bfs_batch_compact(
+    parents, levels, _ = bfs_batch_compact(
         E, roots_dev, csc=csc, frontier_capacity=fcap, edge_capacity=ecap
     )
     te_dev = batch_traversed_edges(deg_blocks, parents)
     te = np.asarray(jax.device_get(te_dev))  # true barrier (poisons after)
     dt = time.perf_counter() - t0
+
+    validation = None
+    if os.environ.get("BENCH_VALIDATE") == "1":
+        # Graph500 tree validation ON DEVICE (verify.c intent) — after the
+        # timed section (the readback above already poisoned this process,
+        # so the validation launch is slow but harmless to the timing)
+        from combblas_tpu.models.bfs import validate_bfs_device
+
+        import jax.numpy as jnp
+
+        v = np.asarray(
+            jax.device_get(
+                validate_bfs_device(
+                    E, parents,
+                    type(parents)(
+                        blocks=levels.blocks.astype(jnp.int32),
+                        length=levels.length, align=levels.align,
+                        grid=levels.grid,
+                    ),
+                )
+            )
+        )
+        validation = {
+            "roots_bad": int(v[0].sum()),
+            "level_step_bad": int(v[1].sum()),
+            "tree_edge_bad": int(v[2].sum()),
+            "edge_consistency_bad": int(v[3].sum()),
+        }
 
     # --- Phase 4: accounting ----------------------------------------------
     total_te = int(te.astype(np.int64).sum())
@@ -194,6 +222,8 @@ def child(graph_path: str):
         "reachable_roots": int((te > 0).sum()),
         "construction_child_s": round(construction_child_s, 2),
     }
+    if validation is not None:
+        out["validation"] = validation
     if mteps < OPERATING_MTEPS / 2 and SCALE == 20 and NROOTS == 256:
         out["warning"] = (
             f"{mteps:.1f} MTEPS is >2x below the recorded operating point "

@@ -1,12 +1,25 @@
-"""Application-level single-chip benchmarks: PageRank and triangle count.
+"""Application-level single-chip benchmarks (BASELINE.md tracked configs).
 
-Same axon-safe protocol as bench.py (host build, one upload, one timed
-launch closed by a scalar readback). Prints one JSON line per app.
+Same axon-safe protocol as bench.py (host build + host symbolic sizing,
+one upload, one timed launch closed by a scalar readback). Prints one
+JSON line per app. One app per process (fresh-process rule).
 
 APP=pagerank: K power iterations of the PLUS_TIMES ELL SpMV with teleport
 (the PageRank.cpp loop, :126-157) fused into one launch.
-APP=tc: L = tril(A); count = sum((L·L) .* L) — TC.cpp:104-116 — via the
-masked ESC SpGEMM.
+APP=ppr: W personalized-PageRank chains in ONE program
+(``pagerank_batch`` — the multi-root amortization; compare s/iter
+against APP=pagerank to see the per-index gather cost split W ways).
+APP=tc: L = tril(A); count = sum((L·L) .* L) — TC.cpp:104-116 — host
+symbolic sizing + one fused launch (no mid-run readbacks).
+APP=cc: FastSV connected components (one while_loop launch).
+APP=lacc: LACC star hooking/shortcutting (one while_loop launch).
+APP=sssp: Bellman-Ford MIN_PLUS fixed point (one while_loop launch).
+APP=bc: batched Brandes from BENCH_ROOTS sources (host loop per level —
+the reference's while(fringe.getnnz()) shape; per-level sizing readbacks
+degrade this chip (D2H poison), recorded as-is).
+APP=mcl: BENCH_ITERS expand/prune/inflate iterations in ONE launch with
+frozen host-sized capacities (the chaos_every machinery); overflow flags
+checked after timing.
 """
 
 from __future__ import annotations
@@ -125,10 +138,331 @@ def bench_tc():
     )
 
 
+def bench_ppr():
+    """W personalized-PageRank chains, one program (pagerank_batch)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from combblas_tpu.models.pagerank import pagerank_batch
+    from combblas_tpu.parallel.ellmat import EllParMat
+    from combblas_tpu.parallel.grid import Grid
+    from combblas_tpu.parallel.vec import DistVec
+
+    W = int(os.environ.get("BENCH_ROOTS", "64"))
+    r, c, n = _graph(SCALE)
+    grid = Grid.make(1, 1)
+    deg = np.bincount(c, minlength=n).astype(np.float32)
+    w = (1.0 / np.maximum(deg, 1.0))[c].astype(np.float32)
+    E = EllParMat.from_host_coo(grid, r, c, w, n, n)
+    dang = DistVec.from_global(
+        grid, (deg == 0).astype(np.float32), align="col"
+    )
+    rng = np.random.default_rng(0)
+    srcs = jnp.asarray(
+        rng.choice(np.flatnonzero(deg > 0), size=W, replace=False), jnp.int32
+    )
+    # fixed iteration count (tol=0 -> runs max_iters): clean s/iter
+    ranks, it = pagerank_batch(
+        E, srcs, dang, tol=0.0, max_iters=ITERS
+    )
+    jax.block_until_ready(ranks.blocks)
+    time.sleep(3)
+    t0 = time.perf_counter()
+    ranks, it = pagerank_batch(E, srcs, dang, tol=0.0, max_iters=ITERS)
+    _ = float(jax.device_get(ranks.blocks[0, 0, 0]))
+    dt = time.perf_counter() - t0
+    print(
+        json.dumps(
+            {
+                "metric": f"ppr_batch{W}_rmat_scale{SCALE}_GFLOPs",
+                "value": round(len(r) * 2 * W * ITERS / dt / 1e9, 3),
+                "unit": "GFLOP/s",
+                "nnz": len(r),
+                "roots": W,
+                "iters": ITERS,
+                "ms_per_iter": round(dt / ITERS * 1e3, 2),
+                "ms_per_iter_per_root": round(dt / ITERS / W * 1e3, 3),
+            }
+        )
+    )
+
+
+def bench_tc_fused():
+    """TC with host symbolic sizing + ONE fused launch (axon-safe)."""
+    import jax
+    import numpy as np
+
+    from combblas_tpu import PLUS_TIMES
+    from combblas_tpu.parallel.grid import Grid
+    from combblas_tpu.parallel.spgemm import (
+        summa_capacities_host,
+        summa_spgemm,
+        summa_stage_flops_host,
+    )
+    from combblas_tpu.parallel.spmat import SpParMat
+
+    r, c, n = _graph(SCALE, ef=8)
+    grid = Grid.make(1, 1)
+    m = r > c  # strict lower triangle, host-side
+    lr_, lc_ = r[m], c[m]
+    fcap, ocap = summa_capacities_host(grid, lr_, lc_, lr_, lc_, n, n, n)
+    ntri_host = None
+    L = SpParMat.from_global_coo(
+        grid, lr_, lc_, np.ones(len(lr_), np.float32), n, n
+    )
+
+    @jax.jit
+    def count(Lm):
+        B = summa_spgemm(
+            PLUS_TIMES, Lm, Lm, flop_capacity=fcap, out_capacity=ocap
+        )
+        C = B.ewise_mult(Lm)
+        return C.reduce(PLUS_TIMES, axis="rows").reduce(PLUS_TIMES)
+
+    t = count(L)
+    jax.block_until_ready(t)
+    time.sleep(3)
+    t0 = time.perf_counter()
+    t = count(L)
+    n_tri = int(jax.device_get(t))
+    dt = time.perf_counter() - t0
+    flops = int(
+        summa_stage_flops_host(
+            grid, lr_, lc_, lr_, lc_, n, n, n, padded=False
+        ).sum()
+    )
+    print(
+        json.dumps(
+            {
+                "metric": f"tc_rmat_scale{SCALE}_s",
+                "value": round(dt, 2),
+                "unit": "s",
+                "triangles": n_tri,
+                "nnz": int(len(r)),
+                "MFLOPs": round(flops * 2 / dt / 1e6, 2),
+            }
+        )
+    )
+
+
+def bench_cc(algo: str):
+    import jax
+    import numpy as np
+
+    from combblas_tpu.models.cc import connected_components, lacc
+    from combblas_tpu.parallel.grid import Grid
+    from combblas_tpu.parallel.spmat import SpParMat
+
+    r, c, n = _graph(SCALE)
+    grid = Grid.make(1, 1)
+    A = SpParMat.from_global_coo(
+        grid, r, c, np.ones(len(r), np.float32), n, n
+    )
+    fn = lacc if algo == "lacc" else connected_components
+    labels, it = fn(A)
+    jax.block_until_ready(labels.blocks)
+    time.sleep(3)
+    t0 = time.perf_counter()
+    labels, it = fn(A)
+    _ = int(jax.device_get(labels.blocks[0, 0]))
+    dt = time.perf_counter() - t0
+    print(
+        json.dumps(
+            {
+                "metric": f"{algo}_rmat_scale{SCALE}_s",
+                "value": round(dt, 3),
+                "unit": "s",
+                "nnz": len(r),
+                "iters": int(jax.device_get(it)),
+                "MTEPS": round(len(r) * int(jax.device_get(it)) / dt / 1e6, 1),
+            }
+        )
+    )
+
+
+def bench_sssp():
+    import jax
+    import numpy as np
+
+    from combblas_tpu.models.sssp import sssp
+    from combblas_tpu.parallel.grid import Grid
+    from combblas_tpu.parallel.spmat import SpParMat
+
+    r, c, n = _graph(SCALE)
+    grid = Grid.make(1, 1)
+    rng = np.random.default_rng(0)
+    w = (rng.random(len(r)) + 0.01).astype(np.float32)
+    A = SpParMat.from_global_coo(grid, r, c, w, n, n)
+    dist, it = sssp(A, 0)
+    jax.block_until_ready(dist.blocks)
+    time.sleep(3)
+    t0 = time.perf_counter()
+    dist, it = sssp(A, 0)
+    _ = float(jax.device_get(dist.blocks[0, 0]))
+    dt = time.perf_counter() - t0
+    niter = int(jax.device_get(it))
+    print(
+        json.dumps(
+            {
+                "metric": f"sssp_rmat_scale{SCALE}_s",
+                "value": round(dt, 3),
+                "unit": "s",
+                "nnz": len(r),
+                "iters": niter,
+                "MTEPS": round(len(r) * niter / dt / 1e6, 1),
+            }
+        )
+    )
+
+
+def bench_bc():
+    import jax
+    import numpy as np
+
+    from combblas_tpu.models.bc import bc_batch
+    from combblas_tpu.parallel.grid import Grid
+    from combblas_tpu.parallel.spmat import SpParMat
+
+    W = int(os.environ.get("BENCH_ROOTS", "16"))
+    r, c, n = _graph(SCALE, ef=8)
+    grid = Grid.make(1, 1)
+    A = SpParMat.from_global_coo(
+        grid, r, c, np.ones(len(r), np.float32), n, n
+    )
+    rng = np.random.default_rng(0)
+    deg = np.bincount(r, minlength=n)
+    srcs = rng.choice(np.flatnonzero(deg > 0), size=W, replace=False)
+    AT = A.transpose()
+    scores = bc_batch(A, srcs, AT=AT)  # warmup (compiles per-level shapes)
+    jax.block_until_ready(scores.blocks)
+    time.sleep(3)
+    t0 = time.perf_counter()
+    scores = bc_batch(A, srcs, AT=AT)
+    _ = float(jax.device_get(scores.blocks[0, 0]))
+    dt = time.perf_counter() - t0
+    print(
+        json.dumps(
+            {
+                "metric": f"bc_batch{W}_rmat_scale{SCALE}_s",
+                "value": round(dt, 2),
+                "unit": "s",
+                "nnz": len(r),
+                "roots": W,
+                "note": "host level loop; per-level sizing readbacks "
+                        "degrade this chip (D2H poison)",
+            }
+        )
+    )
+
+
+def bench_mcl():
+    """BENCH_ITERS MCL iterations in ONE launch, frozen host-sized caps."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from combblas_tpu.models.mcl import (
+        _mcl2d_iter_device,
+        make_col_stochastic,
+    )
+    from combblas_tpu.parallel.grid import Grid
+    from combblas_tpu.parallel.spgemm import summa_capacities_host
+    from combblas_tpu.parallel.spmat import SpParMat
+
+    K = ITERS
+    r, c, n = _graph(SCALE, ef=8)
+    grid = Grid.make(1, 1)
+    # self-loops added HOST-side so the symbolic sizing sees the matrix
+    # the loop actually squares
+    diag = np.arange(n, dtype=np.int64)
+    r = np.concatenate([r, diag])
+    c = np.concatenate([c, diag])
+    fcap, ocap = summa_capacities_host(
+        grid, r, c, r, c, n, n, n, slack=2.0
+    )
+    # Frozen caps must cover LATER iterations too: each squares the
+    # previous PRUNED matrix, whose flops are bounded by select^2 * n
+    # (<= select entries per column in both operands). BENCH_SELECT
+    # trades cluster granularity for a provable capacity bound.
+    SELECT = int(os.environ.get("BENCH_SELECT", "64"))
+    # CAPX covers the select-bound breaking under VALUE TIES: kselect
+    # thresholds keep every tied entry (early MCL iterations tie heavily
+    # at 1/deg), so columns can exceed SELECT entries and the flop bound
+    # with them (overflow flag in the output = raise CAPX).
+    CAPX = int(os.environ.get("BENCH_CAPX", "4"))
+    bound = SELECT * SELECT * n
+    rnd = lambda x: 1 << (max(int(x), 1) - 1).bit_length()
+    caps = (
+        rnd(CAPX * max(fcap, bound)),
+        # distinct output keys <= min(flop bound, dense)
+        min(rnd(min(CAPX * max(ocap, bound), n * n)), n * n),
+    )
+    prune_kwargs = dict(
+        hard_threshold=1e-4, select_num=SELECT,
+        recover_num=SELECT + SELECT // 4, recover_pct=0.9,
+    )
+    A = SpParMat.from_global_coo(
+        grid, r, c, np.ones(len(r), np.float32), n, n,
+    )
+
+    from jax import lax
+
+    @jax.jit
+    def block(A0):
+        A1 = make_col_stochastic(A0)
+        # iteration 1 separately (input capacity differs from ocap)...
+        A1, ch, worst = _mcl2d_iter_device(A1, caps, 2.0, prune_kwargs)
+
+        # ...then a fori_loop over the shape-stable remainder (a python
+        # unroll of K iterations produced an HLO too large for the
+        # remote compiler at chip scales)
+        def body(_, st):
+            Ak, _ch, worst = st
+            Ak, ch2, ov = _mcl2d_iter_device(Ak, caps, 2.0, prune_kwargs)
+            return Ak, ch2, jnp.maximum(worst, ov)
+
+        A1, ch, worst = lax.fori_loop(0, K - 1, body, (A1, ch, worst))
+        return A1, ch, worst
+
+    out, ch, worst = block(A)
+    jax.block_until_ready(out.vals)
+    time.sleep(3)
+    t0 = time.perf_counter()
+    out, ch, worst = block(A)
+    ch_v = float(jax.device_get(ch))
+    dt = time.perf_counter() - t0
+    print(
+        json.dumps(
+            {
+                "metric": f"mcl_rmat_scale{SCALE}_s_per_iter",
+                "value": round(dt / K, 2),
+                "unit": "s/iter",
+                "iters": K,
+                "nnz": len(r),
+                "chaos": round(ch_v, 5),
+                "overflow": int(jax.device_get(worst)),
+            }
+        )
+    )
+
+
 if __name__ == "__main__":
     if APP == "pagerank":
         bench_pagerank()
+    elif APP == "ppr":
+        bench_ppr()
     elif APP == "tc":
-        bench_tc()
+        bench_tc_fused()
+    elif APP in ("cc", "fastsv"):
+        bench_cc("fastsv")
+    elif APP == "lacc":
+        bench_cc("lacc")
+    elif APP == "sssp":
+        bench_sssp()
+    elif APP == "bc":
+        bench_bc()
+    elif APP == "mcl":
+        bench_mcl()
     else:
         raise SystemExit(f"unknown BENCH_APP {APP}")

@@ -16,9 +16,11 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 SCALE = int(os.environ.get("BENCH_SCALE", "14"))
 REPS = int(os.environ.get("BENCH_REPS", "3"))
-KERNEL = os.environ.get("BENCH_KERNEL", "esc")  # esc | mxu
+KERNEL = os.environ.get("BENCH_KERNEL", "esc")  # esc | mxu | scan | scanphased
+PHASES = int(os.environ.get("BENCH_PHASES", "8"))  # scanphased only
 OCAP = os.environ.get("BENCH_OCAP")  # override out_capacity (mxu sparsify
-# cost scales with it: searchsorted queries per slot)
+# cost scales with it: searchsorted queries per slot; scan: accumulator
+# slots — sized from the exact host symbolic out-nnz when unset)
 
 
 def main():
@@ -45,10 +47,31 @@ def main():
     # pass would need a D2H readback before the timed launches, which
     # permanently degrades them — see bench.py module docstring).
     per_stage = summa_stage_flops_host(grid, ru, cu, ru, cu, n, n, n)
-    flops = int(per_stage.sum())
+    # true scalar multiplies for the MFLOP/s numerator (per_stage above is
+    # chunk-padded for capacity sizing)
+    flops = int(
+        summa_stage_flops_host(
+            grid, ru, cu, ru, cu, n, n, n, padded=False
+        ).sum()
+    )
     fcap, ocap = summa_capacities_host(
         grid, ru, cu, ru, cu, n, n, n, per_stage=per_stage
     )
+    if KERNEL == "scan":
+        # exact output structure on host: out_capacity = nnz(A^2) — the
+        # scan variant's accumulator scales with the OUTPUT, which is what
+        # lets scale 16 fit in HBM (the round-2 all-stages-live ESC
+        # faulted the device there).
+        if OCAP:
+            ocap = int(OCAP)
+        else:
+            from scipy import sparse
+
+            S = sparse.csr_matrix(
+                (np.ones(len(ru), np.float32), (ru, cu)), shape=(n, n)
+            )
+            nnz_out = int((S @ S).nnz)
+            ocap = 1 << int(np.ceil(np.log2(max(nnz_out, 2) * 1.05)))
     A = SpParMat.from_global_coo(
         grid, ru, cu, np.ones(len(ru), np.float32), n, n
     )
@@ -60,7 +83,123 @@ def main():
     import jax.numpy as jnp
     from jax import lax
 
-    if KERNEL == "mxu":
+    if KERNEL == "scanphased":
+        # MemEfficientSpGEMM pattern at benchmark level: B's columns split
+        # into flop-BALANCED phases (host symbolic), every phase runs the
+        # output-bounded scan kernel with ONE shared capacity set (single
+        # compile), all sizing on host before any launch (axon D2H rule).
+        # This is what fits scale 16 in HBM: the single-stage expansion
+        # (~420M slots x3 arrays, doubled by the sort) exhausts the 16G
+        # device; per-phase working sets are PHASES-fold smaller.
+        from scipy import sparse as _sp
+
+        from combblas_tpu.parallel.spgemm import summa_spgemm_scan
+
+        deg = np.bincount(ru, minlength=n)
+        colflops = deg[cu]  # flops contributed by each entry (B-row walk)
+        # order entries by column; split columns at equal-flop boundaries
+        order = np.argsort(cu, kind="stable")
+        cum = np.cumsum(colflops[order])
+        co = cu[order]
+        bounds = [0]
+        for ph in range(1, PHASES):
+            t = cum[-1] * ph / PHASES
+            b = min(int(np.searchsorted(cum, t)), len(order) - 1)
+            # snap DOWN to the column boundary: a split column would be
+            # produced by two phases and double-count its outputs
+            bounds.append(int(np.searchsorted(co, co[b], side="left")))
+        bounds.append(len(order))
+        Bs = []
+        fcapp = ocapp = 1
+        S = _sp.csr_matrix(
+            (np.ones(len(ru), np.float32), (ru, cu)), shape=(n, n)
+        )
+        # ONE host product: every phase output is a column range of it
+        # (phases are column-disjoint), so per-phase out-nnz reads off the
+        # CSC indptr instead of PHASES more host SpGEMMs
+        Pcsc = (S @ S).tocsc()
+        col_nnz = np.diff(Pcsc.indptr)
+        for ph in range(PHASES):
+            sel = order[bounds[ph]:bounds[ph + 1]]
+            rp, cp = ru[sel], cu[sel]
+            per = summa_stage_flops_host(grid, ru, cu, rp, cp, n, n, n)
+            fcapp = max(fcapp, int(per.max() * 1.05) + 1)
+            if len(cp):
+                lo, hi = int(cp.min()), int(cp.max()) + 1
+                ph_nnz = int(col_nnz[lo:hi].sum())
+                ocapp = max(ocapp, int(ph_nnz * 1.05) + 1)
+            Bs.append(
+                SpParMat.from_global_coo(
+                    grid, rp, cp, np.ones(len(rp), np.float32), n, n
+                )
+            )
+        rnd = lambda x: 1 << (x - 1).bit_length()
+        fcapp, ocapp = rnd(fcapp), rnd(ocapp)
+        # equalize slot capacities so ALL phases share one compiled program
+        cap_b = rnd(max(int(b.capacity) for b in Bs))
+        Bs = [b.with_capacity(cap_b) for b in Bs]
+        A = A.shrink_to_fit()
+
+        def phase_mult(a, b):
+            return summa_spgemm_scan(
+                PLUS_TIMES, a, b, flop_capacity=fcapp, out_capacity=ocapp
+            )
+
+        outs = [phase_mult(A, b) for b in Bs]  # warmup/compile (cached)
+        jax.block_until_ready(outs[-1][0].vals)
+        time.sleep(3)
+        t0 = time.perf_counter()
+        nnz_total = jnp.int32(0)
+        ov_total = jnp.int32(0)
+        for _ in range(REPS):
+            for b in Bs:
+                Cp, ov = phase_mult(A, b)
+                nnz_total = nnz_total + Cp.getnnz()
+                ov_total = jnp.maximum(ov_total, ov)
+        nnz_v = int(jax.device_get(nnz_total)) // REPS  # barrier
+        dt = time.perf_counter() - t0
+        print(
+            json.dumps(
+                {
+                    "metric": f"spgemm_AxA_rmat_scale{SCALE}_scanphased{PHASES}_MFLOPs",
+                    "value": round(flops * 2 * REPS / dt / 1e6, 2),
+                    "unit": "MFLOP/s",
+                    "flops": int(flops),
+                    "ms_per_spgemm": round(dt / REPS * 1e3, 2),
+                    "out_nnz": nnz_v,
+                    "overflow": int(jax.device_get(ov_total)),
+                }
+            )
+        )
+        return
+    if KERNEL == "scan":
+        from combblas_tpu.parallel.spgemm import summa_spgemm_scan
+
+        overflow_dev = None
+
+        @jax.jit
+        def chain(mat):
+            def body(_, carry):
+                a = dataclasses.replace(mat, vals=mat.vals + carry * 0)
+                C, ov = summa_spgemm_scan(
+                    PLUS_TIMES, a, a,
+                    flop_capacity=fcap, out_capacity=ocap,
+                )
+                return C.vals[0, 0, 0] * 0 + ov.astype(jnp.float32) * 0
+
+            return lax.fori_loop(0, REPS, body, jnp.float32(0))
+
+        out = chain(A)  # warmup/compile
+        jax.block_until_ready(out)
+        time.sleep(3)
+        t0 = time.perf_counter()
+        out = chain(A)
+        _ = float(jax.device_get(out))  # barrier
+        dt = time.perf_counter() - t0
+        C, overflow_dev = summa_spgemm_scan(
+            PLUS_TIMES, A, A, flop_capacity=fcap, out_capacity=ocap
+        )
+    elif KERNEL == "mxu":
         from combblas_tpu.parallel.spgemm import summa_spgemm_mxu
 
         mxu_ocap = int(OCAP) if OCAP else ocap
@@ -117,10 +256,13 @@ def main():
                 "flops": int(flops),
                 "ms_per_spgemm": round(dt / REPS * 1e3, 2),
                 "out_nnz": int(jax.device_get(C.getnnz())),
-                # nonzero = BENCH_OCAP truncated the product; numbers invalid
+                # nonzero = capacity truncated the product; numbers invalid
                 "overflow": (
                     int(jax.device_get(mxu_overflow))
-                    if KERNEL == "mxu" else 0
+                    if KERNEL == "mxu"
+                    else int(jax.device_get(overflow_dev))
+                    if KERNEL == "scan"
+                    else 0
                 ),
             }
         )

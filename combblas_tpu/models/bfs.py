@@ -297,6 +297,124 @@ def validate_bfs_tree(A_dense, source, parents, levels) -> list[str]:
     return errs
 
 
+@jax.jit
+def validate_bfs_device(E, parents, levels):
+    """DEVICE-side Graph500 tree validation for chip-scale runs
+    (``graph500-1.2 verify.c`` intent; the host ``validate_bfs_tree`` is
+    O(n·m) Python and unusable at benchmark scales).
+
+    ``E``: EllParMat adjacency; ``parents``/``levels``: row-aligned
+    DistMultiVec int32 [n, W] (levels -1 = undiscovered). Checks, per
+    lane, with a handful of bucket-sweep passes (each ~nnz per-slot ops):
+
+      v1  roots: exactly one self-parent vertex at level 0 per lane;
+      v2  level step: level[v] == level[parent[v]] + 1 for discovered
+          non-root v (and parent discovered);
+      v3  tree-edge membership: edge (parent[v], v) exists in the graph;
+      v4  edge consistency: no graph edge joins a discovered vertex to an
+          undiscovered one, and discovered endpoints' levels differ <= 1
+          (levels are true BFS distances & discovery is closed).
+
+    Returns a [4, W] int32 violation-count matrix (all zeros = valid).
+    Run AFTER the timed section — its readback poisons later launches.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.grid import COL_AXIS, ROW_AXIS
+    from ..parallel.spmat import TILE_SPEC
+
+    grid = E.grid
+    n = E.nrows
+    lr, lc = E.local_rows, E.local_cols
+    nb = len(E.buckets)
+    lcol = levels.realign("col")
+
+    def body(prow_b, lrow_b, lcol_b, *flat):
+        buckets = [
+            tuple(a[0, 0] for a in flat[3 * i : 3 * i + 3]) for i in range(nb)
+        ]
+        prow, lrow = prow_b[0], lrow_b[0]  # [lr, W]
+        lc_b = lcol_b[0]  # [lc, W]
+        W = prow.shape[1]
+        i = jax.lax.axis_index(ROW_AXIS)
+        j = jax.lax.axis_index(COL_AXIS)
+        row_g = jnp.arange(lr, dtype=jnp.int32) + i * lr  # global row ids
+        rvalid = row_g < n
+
+        # v1: root accounting (root = self-parent at level 0)
+        is_root = (prow == row_g[:, None]) & (lrow == 0) & rvalid[:, None]
+        nroots = jax.lax.psum(
+            jnp.sum(is_root.astype(jnp.int32), axis=0), ROW_AXIS
+        )
+        v1 = jnp.abs(nroots - 1)
+
+        # full per-lane level table for parent lookups (validation W is
+        # small; all_gather of [lc, W] over "c" = the global vector)
+        lvl_full = jax.lax.all_gather(lc_b, COL_AXIS).reshape(-1, W)[:n]
+        disc = (lrow >= 0) & rvalid[:, None]
+        nonroot = disc & ~is_root
+        pidx = jnp.clip(prow, 0, n - 1)
+        lane = jnp.arange(W, dtype=jnp.int32)[None, :]
+        lp = lvl_full[pidx, lane]  # lp[v, w] = level[parent[v, w], w]
+        v2 = jax.lax.psum(
+            jnp.sum(
+                (nonroot & ((lp < 0) | (lrow != lp + 1))).astype(jnp.int32),
+                axis=0,
+            ),
+            ROW_AXIS,
+        )
+
+        # v3 + v4: one sweep over the ELL buckets
+        lpad = jnp.concatenate(
+            [lc_b, jnp.full((1, W), -1, lc_b.dtype)]
+        )  # [lc+1, W]
+        tree_found = jnp.zeros((lr, W), bool)
+        v4 = jnp.zeros((W,), jnp.int32)
+        for bc0, _bv0, br0 in buckets:  # the shard-LOCAL tile slices
+            rowok = br0 < lr  # padded bucket rows are inert
+            slot_ok = (bc0 < lc) & rowok[:, None]  # [nbk, kb]
+            colg = jnp.where(slot_ok, bc0 + j * lc, n)
+            g = lpad[jnp.minimum(bc0, lc)]  # [nbk, kb, W] neighbor levels
+            rl = lrow[jnp.minimum(br0, lr - 1)]  # [nbk, W] row levels
+            rd = rl >= 0
+            nd = g >= 0
+            bad_cross = slot_ok[..., None] & (rd[:, None, :] != nd)
+            bad_far = (
+                slot_ok[..., None]
+                & rd[:, None, :] & nd
+                & (jnp.abs(g - rl[:, None, :]) > 1)
+            )
+            v4 = v4 + jnp.sum(
+                (bad_cross | bad_far).astype(jnp.int32), axis=(0, 1)
+            )
+            pv = prow[jnp.minimum(br0, lr - 1)]  # [nbk, W] parent ids
+            match = slot_ok[..., None] & (colg[..., None] == pv[:, None, :])
+            hit = jnp.any(match, axis=1) & rowok[:, None]  # [nbk, W]
+            tree_found = tree_found.at[jnp.minimum(br0, lr - 1)].max(hit)
+        # a row's full adjacency may span several grid columns
+        tree_found = jax.lax.pmax(tree_found, COL_AXIS)
+        v4 = jax.lax.psum(jax.lax.psum(v4, COL_AXIS), ROW_AXIS)
+        v3 = jax.lax.psum(
+            jnp.sum((nonroot & ~tree_found).astype(jnp.int32), axis=0),
+            ROW_AXIS,
+        )
+        return jnp.stack([v1, v2, v3, v4])[None]
+
+    flat_args = [a for b in E.buckets for a in b]
+    out = jax.shard_map(
+        body,
+        mesh=grid.mesh,
+        in_specs=(P(ROW_AXIS), P(ROW_AXIS), P(COL_AXIS))
+        + (TILE_SPEC,) * (3 * nb),
+        out_specs=P(None),
+        check_vma=False,
+    )(
+        parents.realign("row").blocks, levels.realign("row").blocks,
+        lcol.blocks, *flat_args,
+    )
+    return out[0]
+
+
 @partial(jax.jit, static_argnames=("max_iters", "sr", "track_levels"))
 def bfs_batch(
     A,

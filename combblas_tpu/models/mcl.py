@@ -113,6 +113,7 @@ def mcl_prune_recovery_select(
     select_num: int = 1100,
     recover_num: int = 1400,
     recover_pct: float = 0.9,
+    device_gate: bool = False,
 ) -> SpParMat:
     """The MCL column sparsifier.
 
@@ -122,6 +123,12 @@ def mcl_prune_recovery_select(
       3. recovery: columns that lost more than ``1 - recover_pct`` of their
          mass relax to the top-``recover_num`` threshold instead (columns
          with fewer than ``recover_num`` entries recover fully).
+
+    ``device_gate=True`` keeps the recovery decision ON DEVICE (always
+    compute the recover-side kselect, blend with ``where``) — required
+    inside a zero-readback iteration block (see ``mcl(chaos_every=...)``);
+    the default host gate skips that kselect in the common no-recovery
+    case, which is cheaper when the loop syncs anyway.
     """
     if hard_threshold > 0:
         C = C.prune(_lt_pred(float(hard_threshold)))
@@ -130,11 +137,11 @@ def mcl_prune_recovery_select(
     kept = pruned.reduce(PLUS_TIMES, "rows")
     orig = C.reduce(PLUS_TIMES, "rows")
     need_recover = kept.ewise(orig, lambda k, o: k < recover_pct * o)
-    # Host-side gate (the loop already syncs per phase): the recover-side
-    # kselect is the sparsifier's most expensive collective — skip it in the
-    # common case where no column lost enough mass, as the reference gates
-    # recovery on the measured loss (ParFriends.h:266-311).
-    if not bool(need_recover.blocks.any()):
+    # Host-side gate (the per-sync loop): the recover-side kselect is the
+    # sparsifier's most expensive collective — skip it in the common case
+    # where no column lost enough mass, as the reference gates recovery on
+    # the measured loss (ParFriends.h:266-311).
+    if not device_gate and not bool(need_recover.blocks.any()):
         return pruned
     r_th = C.kselect(recover_num)
     relaxed = r_th.ewise(s_th, jnp.minimum)
@@ -159,6 +166,7 @@ def mcl(
     layers: int = 1,
     grid3=None,
     scan: bool = False,
+    chaos_every: int = 1,
 ) -> tuple[DistVec, int, float]:
     """Markov clustering. Returns (cluster labels, iterations, final chaos).
 
@@ -186,6 +194,19 @@ def mcl(
     a row-aligned int32 DistVec where each vertex carries the smallest
     vertex id of its cluster (the component labeling of the converged
     attractor structure).
+
+    ``chaos_every=K > 1`` runs K expansion iterations per host
+    synchronization with the chaos residual carried ON DEVICE — zero
+    device→host readbacks inside a K-block. On hardware where any D2H
+    readback degrades later launches (the axon chip; bench.py module
+    docstring), this is the difference between one poisoned sync per
+    iteration and one per K. Capacities are frozen at block entry (2x
+    headroom, power-of-two) and every block verifies on-device overflow
+    flags at its sync point; on overflow the block RERUNS from its saved
+    entry state with doubled capacities, so results are exact. Requires
+    ``phases == 1`` (the scan expansion already bounds memory by the
+    output). The reference has no analog — its loop Allreduces chaos
+    every iteration (MCL.cpp:564-627).
     """
     if add_self_loops:
         A = A.add_loops(jnp.asarray(1, A.dtype))
@@ -210,7 +231,19 @@ def mcl(
                 hard_threshold=hard_threshold, select_num=select_num,
                 recover_num=recover_num, recover_pct=recover_pct,
             ),
+            chaos_every=chaos_every,
         )
+    elif chaos_every > 1:
+        assert phases == 1, "chaos_every>1 requires phases=1 (scan bounds memory)"
+        A, it, ch = _mcl2d_block_loop(
+            A, inflation, eps, max_iters, chaos_every,
+            dict(
+                hard_threshold=hard_threshold, select_num=select_num,
+                recover_num=recover_num, recover_pct=recover_pct,
+            ),
+        )
+        if hard_threshold > 0:
+            A = A.prune(_lt_pred(float(hard_threshold)))
     else:
 
         def prune_fn(C):
@@ -237,6 +270,82 @@ def mcl(
     sym = A.ewise_add(A.transpose(), PLUS_TIMES)
     labels, _ = connected_components(sym)
     return labels, it, ch
+
+
+# --- K-iterations-per-sync block loop (zero D2H inside a block) ------------
+
+
+def _mcl_block_caps(A: SpParMat) -> tuple[int, int]:
+    """Frozen block capacities from one symbolic pass at the sync point:
+    2x headroom over the CURRENT iteration's needs, power-of-two for
+    compile-cache reuse across blocks."""
+    import numpy as np
+
+    from ..parallel.spgemm import summa_stage_flops
+
+    from ..parallel.spgemm import host_value
+
+    per_stage = host_value(summa_stage_flops(A, A)).astype(np.float64)
+    rnd = lambda x: 1 << max(int(x) - 1, 1).bit_length()
+    dense_tile = A.local_rows * A.local_cols
+    fcap = rnd(per_stage.max() * 2)
+    ocap = min(rnd(per_stage.sum(axis=0).max() * 2), max(dense_tile, 1))
+    return fcap, ocap
+
+
+def _mcl2d_iter_device(A, caps, inflation, prune_kwargs):
+    """ONE MCL iteration with frozen capacities, entirely on device.
+
+    Returns (A_next, chaos_scalar, overflow_scalar): overflow > 0 means a
+    capacity was exceeded (expansion slots or distinct output keys) and
+    the iteration's result is untrustworthy — the caller rerolls the block
+    with doubled capacities.
+    """
+    from ..parallel.spgemm import summa_spgemm_scan, summa_stage_flops
+
+    fcap, ocap = caps
+    flop_need = jnp.max(summa_stage_flops(A, A))
+    C, ov_out = summa_spgemm_scan(
+        PLUS_TIMES, A, A, flop_capacity=fcap, out_capacity=ocap
+    )
+    C = mcl_prune_recovery_select(C, device_gate=True, **prune_kwargs)
+    C = make_col_stochastic(C)
+    ch = chaos(C)
+    A_next = inflate(C, inflation)
+    overflow = jnp.maximum(
+        ov_out, (flop_need > fcap).astype(jnp.int32) * jnp.int32(1 << 30)
+    )
+    return A_next, ch, overflow
+
+
+def _mcl2d_block_loop(A, inflation, eps, max_iters, K, prune_kwargs):
+    """Host loop over K-iteration device blocks: one readback per block,
+    exact results via save-and-reroll on capacity overflow."""
+    ch = float("inf")
+    it = 0
+    caps = None
+    while it < max_iters:
+        if caps is None:
+            caps = _mcl_block_caps(A)
+        k = min(K, max_iters - it)
+        A_entry = A
+        worst = jnp.int32(0)
+        for _ in range(k):
+            A, ch_dev, ov = _mcl2d_iter_device(
+                A, caps, inflation, prune_kwargs
+            )
+            worst = jnp.maximum(worst, ov)
+        # SYNC POINT: the block's only device->host readbacks
+        if int(worst) > 0:
+            dense_tile = max(A_entry.local_rows * A_entry.local_cols, 1)
+            caps = (caps[0] * 2, min(caps[1] * 2, dense_tile))
+            A = A_entry
+            continue
+        ch = float(ch_dev)
+        it += k
+        if ch < eps:
+            break
+    return A, it, ch
 
 
 # --- 3D (communication-avoiding) MCL path (≈ HipMCL layers>1) --------------
@@ -279,9 +388,12 @@ def mcl_prune_recovery_select3d(
     select_num: int = 1100,
     recover_num: int = 1400,
     recover_pct: float = 0.9,
+    device_gate: bool = False,
 ):
     """3D twin of ``mcl_prune_recovery_select`` (the MemEfficientSpGEMM3D
-    prune hook, ParFriends.h:3215-3712 + MCLPruneRecoverySelect)."""
+    prune hook, ParFriends.h:3215-3712 + MCLPruneRecoverySelect).
+    ``device_gate=True`` keeps the recovery decision on device (see the 2D
+    twin)."""
     from ..parallel.mesh3d import (
         kselect3d,
         prune3d,
@@ -296,15 +408,110 @@ def mcl_prune_recovery_select3d(
     kept = reduce3d_cols(PLUS_TIMES, pruned)
     orig = reduce3d_cols(PLUS_TIMES, C3)
     need_recover = kept < recover_pct * orig
-    if not bool(jnp.any(need_recover)):
+    if not device_gate and not bool(jnp.any(need_recover)):
         return pruned
     r_th = kselect3d(C3, recover_num)
     final = jnp.where(need_recover, jnp.minimum(r_th, s_th), s_th)
     return prune_column3d(C3, final, keep=_keep_ge)
 
 
+def _mcl3d_block_caps(A3, B3):
+    """Frozen 3D block capacities from one sync-point symbolic pass:
+    (flop, out, piece) for summa3d + (stage, tile) for the resplit —
+    2x headroom, powers of two."""
+    import numpy as np
+
+    from ..parallel.mesh3d import summa3d_stage_flops
+
+    g3 = A3.grid
+    L = g3.layers
+    from ..parallel.spgemm import host_value
+
+    per_stage = host_value(summa3d_stage_flops(A3, B3)).astype(np.float64)
+    rnd = lambda x: 1 << max(int(x) - 1, 1).bit_length()
+    total = per_stage.sum(axis=0)
+    dense_tile = A3.tile_rows * max(B3.ncols // max(g3.pc * L, 1), 1)
+    fcap = rnd(per_stage.max() * 2)
+    pcap = rnd(total.max() * 2)
+    ocap = max(min(rnd(total.max() * L * 2), dense_tile), 1)
+    nnz_tot = float(host_value(jnp.sum(A3.nnz)))
+    ndev = L * g3.pr * g3.pc
+    chunk = A3.capacity
+    per_dest = max(-(-chunk // f) for f in (g3.pc, g3.pr, L))
+    stage_cap = rnd(per_dest * 2)
+    tile_cap = rnd(max(nnz_tot / ndev * 4, 4))
+    return fcap, ocap, pcap, stage_cap, tile_cap
+
+
+def _mcl3d_iter_device(A3, caps, inflation, prune_kwargs):
+    """One 3D MCL iteration with frozen capacities, entirely on device.
+    Returns (A3_next, chaos, overflow)."""
+    from ..parallel.mesh3d import (
+        resplit3d_fixed,
+        summa3d_spgemm,
+        summa3d_stage_flops,
+    )
+
+    fcap, ocap, pcap, stage_cap, tile_cap = caps
+    B3, dropped = resplit3d_fixed(
+        A3, "row", stage_capacity=stage_cap, tile_capacity=tile_cap
+    )
+    flop_need = jnp.max(summa3d_stage_flops(A3, B3))
+    C3 = summa3d_spgemm(
+        PLUS_TIMES, A3, B3,
+        flop_capacity=fcap, out_capacity=ocap, piece_capacity=pcap,
+    )
+    # out-capacity overflow signature: a tile filled to the brim (compact
+    # clamps at capacity, so nnz == cap marks possible truncation)
+    ov_out = jnp.max((C3.nnz >= ocap).astype(jnp.int32))
+    C3 = mcl_prune_recovery_select3d(C3, device_gate=True, **prune_kwargs)
+    C3 = make_col_stochastic3d(C3)
+    ch = chaos3d(C3)
+    A_next = inflate3d(C3, inflation)
+    big = jnp.int32(1 << 30)
+    overflow = jnp.maximum(
+        dropped.astype(jnp.int32),
+        jnp.maximum(
+            (flop_need > fcap).astype(jnp.int32) * big, ov_out * big
+        ),
+    )
+    return A_next, ch, overflow
+
+
+def _mcl3d_block_loop(A3, inflation, eps, max_iters, K, prune_kwargs):
+    """3D twin of ``_mcl2d_block_loop``: one readback per K-iteration
+    block, save-and-reroll on any frozen-capacity overflow."""
+    from ..parallel.mesh3d import resplit3d
+
+    ch = float("inf")
+    it = 0
+    caps = None
+    while it < max_iters:
+        if caps is None:
+            B3_probe = resplit3d(A3, "row")
+            caps = _mcl3d_block_caps(A3, B3_probe)
+        k = min(K, max_iters - it)
+        A_entry = A3
+        worst = jnp.int32(0)
+        for _ in range(k):
+            A3, ch_dev, ov = _mcl3d_iter_device(
+                A3, caps, inflation, prune_kwargs
+            )
+            worst = jnp.maximum(worst, ov)
+        if int(worst) > 0:  # SYNC: reroll the block with doubled capacities
+            caps = tuple(c * 2 for c in caps)
+            A3 = A_entry
+            continue
+        ch = float(ch_dev)
+        it += k
+        if ch < eps:
+            break
+    return A3, it, ch
+
+
 def _mcl3d_loop(
-    A: SpParMat, grid3, inflation, eps, max_iters, phases, prune_kwargs
+    A: SpParMat, grid3, inflation, eps, max_iters, phases, prune_kwargs,
+    chaos_every: int = 1,
 ):
     """The 3D expansion loop: returns (converged 2D matrix, iters, chaos)."""
     from ..parallel.mesh3d import (
@@ -315,6 +522,16 @@ def _mcl3d_loop(
     )
 
     A3 = SpParMat3D.from_spmat(A, grid3, split="col")
+
+    if chaos_every > 1:
+        assert phases == 1, "chaos_every>1 requires phases=1"
+        A3, it, ch = _mcl3d_block_loop(
+            A3, inflation, eps, max_iters, chaos_every, prune_kwargs
+        )
+        ht = prune_kwargs.get("hard_threshold", 0)
+        if ht > 0:
+            A3 = prune3d(A3, _lt_pred(float(ht)))
+        return A3.to_spmat(A.grid), it, ch
 
     def prune_fn(C3):
         return mcl_prune_recovery_select3d(C3, **prune_kwargs)

@@ -79,3 +79,64 @@ def pagerank(
         cond, step, (x0, jnp.float32(jnp.inf), jnp.int32(0))
     )
     return mk_row(xb), niter
+
+
+@partial(jax.jit, static_argnames=("alpha", "tol", "max_iters"))
+def pagerank_batch(
+    P_ell,
+    sources: jax.Array,
+    dangling: "DistVec",
+    alpha: float = 0.85,
+    tol: float = 1e-6,
+    max_iters: int = 100,
+):
+    """Personalized PageRank for W sources in ONE program (the multi-root
+    amortization of the batched BFS applied to PageRank: the measured chip
+    gather is per-INDEX bound with payload lanes nearly free, so W rank
+    chains cost ~one — PERF_NOTES_r2.md 'batching many PageRank chains').
+
+    ``P_ell``: the COLUMN-NORMALIZED transition matrix as an EllParMat
+    (entry (i,j) = 1/outdeg(j) for edge j->i — normalize host-side while
+    building the ELL buckets). ``sources``: [W] int32 personalization
+    vertices. ``dangling``: col-aligned 0/1 DistVec marking zero-outdegree
+    columns. Returns (row-aligned DistMultiVec of ranks [n, W] — each lane
+    sums to 1, teleporting to ITS source — and the iteration count).
+
+    Reference: ``PageRank.cpp:126-157``'s loop, batched; personalization
+    follows the standard PPR formulation (teleport to e_s instead of 1/n).
+    """
+    from ..parallel.ellmat import dist_spmv_ell_multi
+    from ..parallel.vec import DistMultiVec
+
+    grid = P_ell.grid
+    n = P_ell.nrows
+    W = sources.shape[0]
+
+    row_gids = DistVec.iota(grid, n, jnp.int32, align="row").blocks  # [pr, lr]
+    e_s = (row_gids[..., None] == sources[None, None, :]).astype(jnp.float32)
+    dang_row = dangling.realign("row").blocks  # [pr, lr]
+    rowvalid = (row_gids < n)[..., None]
+
+    def mk(blocks):
+        return DistMultiVec(blocks=blocks, length=n, align="row", grid=grid)
+
+    def cond(state):
+        _, err, it = state
+        return (err > tol) & (it < max_iters)
+
+    def step(state):
+        xb, _, it = state
+        spread = dist_spmv_ell_multi(PLUS_TIMES, P_ell, mk(xb))
+        # per-lane dangling mass teleports to that lane's source
+        dmass = jnp.sum(dang_row[..., None] * xb, axis=(0, 1))  # [W]
+        nb = alpha * (spread.blocks + dmass[None, None, :] * e_s) + (
+            1.0 - alpha
+        ) * e_s
+        nb = jnp.where(rowvalid, nb, 0.0)
+        err = jnp.max(jnp.sum(jnp.abs(nb - xb), axis=(0, 1)))
+        return nb, err, it + 1
+
+    xb, _, niter = jax.lax.while_loop(
+        cond, step, (e_s, jnp.float32(jnp.inf), jnp.int32(0))
+    )
+    return mk(xb), niter

@@ -43,11 +43,22 @@ def segment_reduce(
     if sr.add_kind == "sum":
         # segment_sum's natural fill (0) is the additive identity of any
         # '+'-monoid — no empty-segment patch needed on the hottest path.
-        return jax.ops.segment_sum(vals, ids, num_segments=num_segments)
+        # The sorted-indices hint is worth ~15-20% scatter throughput on
+        # the target chip (benchmarks/results/scatter_probe_r3.txt).
+        return jax.ops.segment_sum(
+            vals, ids, num_segments=num_segments,
+            indices_are_sorted=ids_sorted,
+        )
     if sr.add_kind == "min":
-        out = jax.ops.segment_min(vals, ids, num_segments=num_segments)
+        out = jax.ops.segment_min(
+            vals, ids, num_segments=num_segments,
+            indices_are_sorted=ids_sorted,
+        )
     elif sr.add_kind == "max":
-        out = jax.ops.segment_max(vals, ids, num_segments=num_segments)
+        out = jax.ops.segment_max(
+            vals, ids, num_segments=num_segments,
+            indices_are_sorted=ids_sorted,
+        )
     else:
         return _generic_segment_reduce(
             sr, vals, ids, num_segments, ids_sorted=ids_sorted
@@ -99,17 +110,32 @@ def expand_ranges(lens: jax.Array, capacity: int):
     This is the static-shape analog of the reference's per-column expansion
     loops in local SpGEMM (``mtSpGEMM.h:292-440``) and column walks in SpMSpV
     (``SpImpl.cpp:53-180``): instead of data-dependent loop bounds, we
-    materialize a fixed ``capacity`` of slots and map each back to its source
-    with a searchsorted over the exclusive prefix sum.
+    materialize a fixed ``capacity`` of slots and map each back to its source.
+
+    The flop->owner map is computed by SCATTER + CUMULATIVE MAX, not
+    searchsorted: scatter each source's index (and start) at its start
+    position, then a streaming cummax fills the run. On the target chip a
+    searchsorted here costs ~0.4 us per slot (measured 24.8 s of a 30.7 s
+    scale-14 SpGEMM, benchmarks/results/scatter_probe_r3.txt) while the
+    two scatters touch only ``len(lens)`` slots and the cummaxes stream.
     """
     lens = lens.astype(jnp.int32)
     starts = jnp.concatenate(
         [jnp.zeros((1,), jnp.int32), jnp.cumsum(lens, dtype=jnp.int32)]
     )
     total = starts[-1]
+    n = lens.shape[0]
+    pos = starts[:-1]  # scatter position of each source (>= capacity drops)
+    # owner[f] = max{i : starts[i] <= f}; duplicates (zero-length sources)
+    # resolve to the highest index, matching searchsorted(side='right') - 1.
+    seed = jnp.full((capacity,), -1, jnp.int32).at[pos].max(
+        jnp.arange(n, dtype=jnp.int32), mode="drop"
+    )
+    owner = jnp.clip(lax.cummax(seed), 0, n - 1)
+    # base[f] = starts[owner[f]] by the same construction (starts monotone)
+    base = jnp.zeros((capacity,), jnp.int32).at[pos].max(pos, mode="drop")
+    base = lax.cummax(base)
     f = jnp.arange(capacity, dtype=jnp.int32)
-    owner = jnp.searchsorted(starts, f, side="right").astype(jnp.int32) - 1
-    owner = jnp.clip(owner, 0, lens.shape[0] - 1)
-    offset = f - starts[owner]
+    offset = f - base
     valid = f < total
     return owner, offset, valid, total

@@ -25,6 +25,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 
 from ..semiring import Semiring
 from .compressed import CSR
@@ -49,29 +50,102 @@ def flops(a: SpTuples, b_csr: CSR) -> Array:
     return jnp.sum(per_entry.astype(jnp.float32))
 
 
-def expand(sr: Semiring, a: SpTuples, b_csr: CSR, flop_capacity: int) -> SpTuples:
-    """EXPAND phase: uncombined product tuples (duplicates included).
+#: Contiguous-lane width of the chunked expansion. The target chip's gather
+#: unit is per-INDEX bound with payload lanes up to ~256 B nearly free
+#: (benchmarks/results/PERF_NOTES_r2.md gatherw), while per-element random
+#: gathers run only ~22-27 M/s at every table size
+#: (scatter_probe_r3.txt) — so fetching B rows in W-wide contiguous
+#: windows divides the expansion's gather count by ~W. Slot padding from
+#: rounding each B-row walk up to W is 3-6% on R-MAT at W=32 (flops
+#: concentrate in wide rows); ``flops_padded`` sizes it exactly.
+CHUNK_W = 32
 
-    Output tile has shape (a.nrows, b.ncols) and capacity ``flop_capacity``;
-    flops beyond the capacity are silently truncated — callers must size via
-    ``flops`` (for exactness) or a proven bound.
+
+def flops_padded(a: SpTuples, b_csr: CSR, chunk_w: int = CHUNK_W) -> Array:
+    """Slot count of the chunked expansion: per A-entry
+    ``ceil(deg_B(col)/W) * W`` summed (>= ``flops``; the capacity
+    ``expand`` actually needs).
+
+    EXACT (unlike the float32-accumulated ``flops`` estimate): the CHUNK
+    count sums in int32 (exact below 2^31 chunks ≈ 7e10 slots at W=32,
+    far past HBM) and the float32 result is a multiple of W below
+    2^24 * W slots, hence exactly representable — callers may pass
+    ``int(flops_padded(...))`` with no slack.
     """
     assert a.ncols == b_csr.nrows
+    lens_pad = jnp.concatenate([b_csr.row_lens(), jnp.zeros((1,), jnp.int32)])
+    k = jnp.minimum(a.cols, b_csr.nrows)
+    deg = jnp.where(a.valid_mask(), lens_pad[k], 0)
+    nch = -(-deg // chunk_w)
+    return jnp.sum(nch).astype(jnp.float32) * chunk_w
+
+
+def expand(
+    sr: Semiring,
+    a: SpTuples,
+    b_csr: CSR,
+    flop_capacity: int,
+    chunk_w: int = CHUNK_W,
+) -> SpTuples:
+    """EXPAND phase: uncombined product tuples (duplicates included).
+
+    Output tile has shape (a.nrows, b.ncols) and capacity
+    ``ceil(flop_capacity / chunk_w) * chunk_w``; work beyond it is silently
+    truncated — callers must size via ``flops_padded`` (for exactness) or a
+    proven bound.
+
+    CHUNKED-ELL FORMULATION (round 3): one expansion slot per
+    (A-entry, B-row W-chunk) instead of per flop. Each virtual entry
+    issues ONE gather index whose payload is a contiguous W-window of B's
+    indices/values (vmapped ``dynamic_slice`` → an XLA gather with
+    ``slice_sizes=W`` — the same contiguous-lane pattern as the ELL SpMV,
+    which the chip serves at ~130 M windows/s vs ~25 M/s for per-element
+    gathers). The flop->owner map itself is the scatter+cummax
+    ``expand_ranges`` over chunk counts (V ≈ flops/W entries instead of
+    flops), so the whole phase does O(nnz + flops/W) random work plus
+    streaming passes.
+    """
+    assert a.ncols == b_csr.nrows
+    W = chunk_w
+    v_capacity = -(-flop_capacity // W)
+    # Pad one full window of sentinels: a row's last chunk may extend past
+    # the valid data, and dynamic_slice would otherwise CLAMP the start
+    # backward, silently gathering earlier rows' entries into live lanes.
+    b_indices = jnp.concatenate(
+        [b_csr.indices, jnp.full((W,), b_csr.ncols, jnp.int32)]
+    )
+    b_vals = jnp.concatenate(
+        [b_csr.vals, jnp.zeros((W,), b_csr.vals.dtype)]
+    )
     lens_pad = jnp.concatenate([b_csr.row_lens(), jnp.zeros((1,), jnp.int32)])
     starts_pad = jnp.concatenate([b_csr.indptr[:-1], jnp.zeros((1,), jnp.int32)])
     k = jnp.minimum(a.cols, b_csr.nrows)
     deg = jnp.where(a.valid_mask(), lens_pad[k], 0)
-    owner, offset, valid, _ = expand_ranges(deg, flop_capacity)
-    k_o = jnp.minimum(a.cols[owner], b_csr.nrows)
-    b_slot = jnp.minimum(starts_pad[k_o] + offset, b_csr.capacity - 1)
-    rows = jnp.where(valid, a.rows[owner], a.nrows)
-    cols = jnp.where(valid, b_csr.indices[b_slot], b_csr.ncols)
-    vals = sr.mul(a.vals[owner], b_csr.vals[b_slot])
+    nch = -(-deg // W)
+    owner, chix, valid_v, _ = expand_ranges(nch, v_capacity)
+    # per-virtual-entry (V-sized) gathers — V ≈ flops/W, all small tables
+    a_rows_v = a.rows[owner]
+    a_vals_v = a.vals[owner]
+    k_v = jnp.minimum(a.cols[owner], b_csr.nrows)
+    deg_v = lens_pad[k_v]
+    b0 = jnp.where(valid_v, starts_pad[k_v] + chix * W, 0)
+    # contiguous W-window gathers of B's indices and values
+    # [V, W] computed-index gather; vmap(dynamic_slice) was measured 5-10x
+    # SLOWER on the target chip despite its explicit contiguity (the
+    # slice-gather lowering serializes; benchmarks/results/spgemm_r3a.txt)
+    win = b0[:, None] + jnp.arange(W, dtype=jnp.int32)[None, :]
+    bcols = b_indices[win]
+    bvals = b_vals[win]
+    lane = jnp.arange(W, dtype=jnp.int32)
+    lane_ok = valid_v[:, None] & (chix[:, None] * W + lane[None, :] < deg_v[:, None])
+    rows = jnp.where(lane_ok, a_rows_v[:, None], a.nrows).reshape(-1)
+    cols = jnp.where(lane_ok, bcols, b_csr.ncols).reshape(-1)
+    vals = sr.mul(a_vals_v[:, None], bvals).reshape(-1)
     return SpTuples(
         rows=rows,
         cols=cols,
         vals=vals,
-        nnz=jnp.sum(valid).astype(jnp.int32),
+        nnz=jnp.sum(lane_ok).astype(jnp.int32),
         nrows=a.nrows,
         ncols=b_csr.ncols,
     )

@@ -228,14 +228,22 @@ class SpTuples:
         seg = jnp.cumsum(is_new.astype(jnp.int32)) - 1
         seg = jnp.where(valid, seg, cap)
         vals = segment_reduce(sr, t.vals, seg, cap, ids_sorted=True)
-        scatter_idx = jnp.where(is_new, seg, cap)
-        rows = jnp.full((cap,), self.nrows, jnp.int32).at[scatter_idx].set(
-            t.rows, mode="drop"
-        )
-        cols = jnp.full((cap,), self.ncols, jnp.int32).at[scatter_idx].set(
-            t.cols, mode="drop"
-        )
         distinct = jnp.sum(is_new).astype(jnp.int32)
+        # ONE input-sized permutation scatter + output-sized gathers
+        # (instead of one input-sized scatter per index array): the output
+        # is typically several-fold smaller than the expansion, and this
+        # chip prices scatters/gathers per ELEMENT (~22-27 M/s,
+        # benchmarks/results/scatter_probe_r3.txt).
+        # distinct OOB sentinels keep the unique_indices contract for the
+        # dropped (non-representative) slots
+        slot_ids = jnp.arange(t.capacity, dtype=jnp.int32)
+        scatter_idx = jnp.where(is_new, seg, cap + slot_ids)
+        perm = jnp.zeros((cap,), jnp.int32).at[scatter_idx].set(
+            slot_ids, mode="drop", unique_indices=True,
+        )
+        out_valid = jnp.arange(cap, dtype=jnp.int32) < distinct
+        rows = jnp.where(out_valid, t.rows[perm], self.nrows)
+        cols = jnp.where(out_valid, t.cols[perm], self.ncols)
         nnz = jnp.minimum(distinct, jnp.int32(cap))
         out = SpTuples(
             rows=rows, cols=cols, vals=vals, nnz=nnz,
@@ -272,22 +280,27 @@ class SpTuples:
         return self._select(keep)
 
     def _select(self, keep: Array) -> "SpTuples":
-        """Stable-compact entries where ``keep`` to the front."""
+        """Stable-compact entries where ``keep`` to the front.
+
+        One permutation scatter + per-array gathers (not one scatter per
+        array): scatters and gathers cost the same per element on the
+        target chip, so 1 scatter + 3 gathers beats 3 scatters whenever
+        XLA can fuse the gathers, and never loses.
+        """
         cap = self.capacity
+        nkeep = jnp.sum(keep).astype(jnp.int32)
         pos = jnp.cumsum(keep.astype(jnp.int32)) - 1
-        scatter_idx = jnp.where(keep, pos, cap)
-        rows = jnp.full((cap,), self.nrows, jnp.int32).at[scatter_idx].set(
-            self.rows, mode="drop"
+        slot_ids = jnp.arange(cap, dtype=jnp.int32)
+        scatter_idx = jnp.where(keep, pos, cap + slot_ids)
+        perm = jnp.zeros((cap,), jnp.int32).at[scatter_idx].set(
+            slot_ids, mode="drop", unique_indices=True,
         )
-        cols = jnp.full((cap,), self.ncols, jnp.int32).at[scatter_idx].set(
-            self.cols, mode="drop"
-        )
-        vals = jnp.zeros((cap,), self.vals.dtype).at[scatter_idx].set(
-            self.vals, mode="drop"
-        )
+        out_valid = slot_ids < nkeep
         return SpTuples(
-            rows=rows, cols=cols, vals=vals,
-            nnz=jnp.sum(keep).astype(jnp.int32),
+            rows=jnp.where(out_valid, self.rows[perm], self.nrows),
+            cols=jnp.where(out_valid, self.cols[perm], self.ncols),
+            vals=jnp.where(out_valid, self.vals[perm], 0),
+            nnz=nkeep,
             nrows=self.nrows, ncols=self.ncols,
         )
 

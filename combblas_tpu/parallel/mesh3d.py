@@ -540,18 +540,29 @@ def summa3d_stage_flops(A: SpParMat3D, B: SpParMat3D) -> Array:
             blens = jax.ops.segment_sum(
                 b_valid.astype(jnp.int32), bg_rows[s], num_segments=lrB + 1
             )
+            # chunked-expansion slots, not raw flops (ops.spgemm.CHUNK_W)
+            from ..ops.spgemm import CHUNK_W
+
+            blens = -(-blens // CHUNK_W) * CHUNK_W
             a_valid = ag_rows[s] < lrA
             k = jnp.minimum(ag_cols[s], lrB)
             per_stage.append(
                 jnp.sum(jnp.where(a_valid, blens[k], 0).astype(jnp.float32))
             )
-        return jnp.stack(per_stage)[:, None, None, None]
+        mine = jnp.stack(per_stage)  # [p]
+        # replicated output: host-addressable under multi-host (see the 2D
+        # summa_stage_flops note)
+        g = lax.all_gather(
+            lax.all_gather(lax.all_gather(mine, COL_AXIS), ROW_AXIS),
+            LAYER_AXIS,
+        )  # [L, pr, pc, p]
+        return jnp.transpose(g, (3, 0, 1, 2))
 
     return jax.shard_map(
         body,
         mesh=grid.mesh,
         in_specs=(TILE3_SPEC,) * 3,
-        out_specs=P(None, LAYER_AXIS, ROW_AXIS, COL_AXIS),
+        out_specs=P(),
         check_vma=False,
     )(A.rows, A.cols, B.rows)
 
@@ -568,7 +579,8 @@ def spgemm3d(
     """
     grid = A.grid
     L = grid.layers
-    per_stage = np.asarray(summa3d_stage_flops(A, B), np.float64)
+    from .spgemm import host_value
+    per_stage = host_value(summa3d_stage_flops(A, B)).astype(np.float64)
     flop_cap = max(int(per_stage.max() * slack) + 1, 1)
     total = per_stage.sum(axis=0)  # per (layer, tile)
     piece_cap = max(int(total.max() * slack) + 1, 1)
@@ -1049,6 +1061,25 @@ def dim_apply3d_cols(A3: SpParMat3D, colvec: Array, fn) -> SpParMat3D:
         check_vma=False,
     )(A3.rows, A3.cols, A3.vals, A3.nnz, colvec)
     return dataclasses.replace(A3, rows=r, cols=c, vals=v, nnz=n)
+
+
+def resplit3d_fixed(
+    A3: SpParMat3D, split: str, *, stage_capacity: int, tile_capacity: int
+) -> tuple[SpParMat3D, Array]:
+    """``resplit3d`` with CALLER-FROZEN capacities and no host sizing or
+    retry: returns (converted matrix, device scalar dropped-tuple count).
+
+    The zero-readback building block for iteration blocks (MCL
+    ``chaos_every``): the caller checks ``dropped`` at its sync point and
+    rerolls with bigger capacities instead of this function reading back
+    per call."""
+    if A3.split == split:
+        return A3, jnp.zeros((), jnp.int32)
+    gr, gc, gv = _globalize3d(A3)
+    return redistribute_coo3d(
+        A3.grid, gr, gc, gv, A3.nrows, A3.ncols, split=split,
+        stage_capacity=stage_capacity, tile_capacity=tile_capacity,
+    )
 
 
 def resplit3d(A3: SpParMat3D, split: str, *, slack: float = 2.0,

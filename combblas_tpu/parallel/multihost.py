@@ -45,15 +45,36 @@ def init_distributed(
 
 
 def make_global_grid(pr: int | None = None, pc: int | None = None):
-    """Squarest (or given) 2D Grid over ALL global devices.
+    """Squarest (or given) 2D Grid over the global devices.
 
     Call after ``init_distributed``; every host constructs the identical
     mesh (jax.devices() is globally consistent), which is what makes the
     single-program shard_map SPMD across hosts — the CommGrid ctor's
     ``MPI_Comm_split`` with ranks replaced by device ids.
+
+    When ``pr * pc`` is smaller than the device count (e.g. the square
+    SUMMA subgrid of a rectangular world), devices are picked round-robin
+    ACROSS PROCESSES so every controller still owns addressable shards —
+    a mesh confined to one process's devices would leave the others
+    unable to read even replicated results.
     """
     from .grid import Grid
 
     if pr is None or pc is None:
         return Grid.make_default()
+    devs = jax.devices()
+    need = pr * pc
+    if need < len(devs):
+        by_proc: dict[int, list] = {}
+        for d in devs:
+            by_proc.setdefault(d.process_index, []).append(d)
+        groups = [by_proc[k] for k in sorted(by_proc)]
+        picked = []
+        i = 0
+        while len(picked) < need:
+            g = groups[i % len(groups)]
+            if g:
+                picked.append(g.pop(0))
+            i += 1
+        return Grid.make(pr, pc, devices=picked)
     return Grid.make(pr, pc)

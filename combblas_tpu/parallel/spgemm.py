@@ -45,6 +45,17 @@ from .grid import COL_AXIS, ROW_AXIS
 from .spmat import TILE_SPEC, SpParMat
 
 
+def host_value(x) -> np.ndarray:
+    """Host numpy value of a FULLY-REPLICATED global array, multi-host
+    safe: under multi-process JAX a replicated array still "spans"
+    non-addressable devices, so read one addressable shard (each holds
+    the whole array when the producing shard_map used ``out_specs=P()``).
+    """
+    if jax.process_count() > 1:
+        return np.asarray(x.addressable_shards[0].data)
+    return np.asarray(x)
+
+
 def _check_compat(A: SpParMat, B: SpParMat):
     """≈ CheckSpGEMMCompliance + ProductGrid (ParFriends.h:161,
     CommGrid.cpp:164)."""
@@ -168,14 +179,21 @@ def summa_spgemm(
     )
 
 
-@jax.jit
-def summa_stage_flops(A: SpParMat, B: SpParMat) -> jax.Array:
+@partial(jax.jit, static_argnames=("padded",))
+def summa_stage_flops(A: SpParMat, B: SpParMat, padded: bool = True) -> jax.Array:
     """[p, pr, pc] float32 flop count per stage per output tile.
 
     The distributed symbolic pass (≈ EstimateFLOP, ParFriends.h:356-448).
     Values only (no ``vals`` arrays) cross the ICI: flop counting needs A's
     (rows, cols) for validity/contraction ids and B's rows for row lengths.
+
+    ``padded=True`` (the default) counts CHUNKED-EXPANSION SLOTS — each
+    A-entry's B-row walk rounded up to ``ops.spgemm.CHUNK_W`` lanes, the
+    capacity the expand kernel actually allocates; ``padded=False`` gives
+    true scalar multiplies (EstimateFLOP parity, for reporting).
     """
+    from ..ops.spgemm import CHUNK_W
+
     _check_compat(A, B)
     grid = A.grid
     p = grid.pr
@@ -193,17 +211,24 @@ def summa_stage_flops(A: SpParMat, B: SpParMat) -> jax.Array:
             blens = jax.ops.segment_sum(
                 b_valid.astype(jnp.int32), bg_rows[s], num_segments=lrB + 1
             )
+            if padded:
+                blens = -(-blens // CHUNK_W) * CHUNK_W
             a_valid = ag_rows[s] < A.local_rows
             k = jnp.minimum(ag_cols[s], lrB)
             per_entry = jnp.where(a_valid, blens[k], 0)
             per_stage.append(jnp.sum(per_entry.astype(jnp.float32)))
-        return jnp.stack(per_stage)[:, None, None]
+        mine = jnp.stack(per_stage)  # [p]
+        # Replicate the (tiny) result so every PROCESS can read it whole —
+        # a mesh-sharded output is not host-addressable under multi-host
+        # (sizing does np.asarray on it, tests/_multihost_worker.py).
+        g = lax.all_gather(lax.all_gather(mine, COL_AXIS), ROW_AXIS)
+        return jnp.transpose(g, (2, 0, 1))  # [p, pr, pc]
 
     return jax.shard_map(
         body,
         mesh=grid.mesh,
         in_specs=(TILE_SPEC,) * 3,
-        out_specs=P(None, ROW_AXIS, COL_AXIS),
+        out_specs=P(),
         check_vma=False,
     )(A.rows, A.cols, B.rows)
 
@@ -228,7 +253,7 @@ def summa_capacities(A: SpParMat, B: SpParMat, slack: float = 1.05):
     use ``summa_capacities_host`` from the host COO *before* any device
     work (D2H poison, see bench.py).
     """
-    per_stage = np.asarray(summa_stage_flops(A, B), dtype=np.float64)
+    per_stage = host_value(summa_stage_flops(A, B)).astype(np.float64)
     return _caps_from_stage_flops(
         per_stage, A.local_rows * B.local_cols, slack
     )
@@ -237,6 +262,7 @@ def summa_capacities(A: SpParMat, B: SpParMat, slack: float = 1.05):
 def summa_stage_flops_host(
     grid, rows_a, cols_a, rows_b, cols_b,
     nrows_a: int, ncols_a: int, ncols_b: int,
+    padded: bool = True,
 ) -> np.ndarray:
     """Host-numpy twin of ``summa_stage_flops``: [p, pr, pc] flop counts
     computed from global COO arrays, with zero device interaction.
@@ -253,6 +279,8 @@ def summa_stage_flops_host(
     lrB = grid.local_rows(ncols_a)
     lcB = grid.local_cols(ncols_b)
     assert lcA == lrB, "A col-blocking must equal B row-blocking"
+    from ..ops.spgemm import CHUNK_W
+
     rows_a = np.asarray(rows_a, np.int64)
     cols_a = np.asarray(cols_a, np.int64)
     rows_b = np.asarray(rows_b, np.int64)
@@ -267,6 +295,8 @@ def summa_stage_flops_host(
     countB = np.bincount(
         (sb * p + jb) * lrB + kb, minlength=p * p * lrB
     ).reshape(p, p, lrB)
+    if padded:  # chunked-expansion slots (see summa_stage_flops)
+        countB = -(-countB // CHUNK_W) * CHUNK_W
     # flops[s, i, j] = sum_k countA[i,s,k] * countB[s,j,k]
     return np.einsum(
         "isk,sjk->sij", countA.astype(np.float64), countB.astype(np.float64)
@@ -401,11 +431,14 @@ def estimate_flops(A: SpParMat, B: SpParMat) -> int:
     """Total semiring multiplications of A ⊗ B.
 
     Reference: ``EstimateFLOP`` (ParFriends.h:356-448) — here the exact
-    distributed symbolic pass summed over stages and tiles.
+    distributed symbolic pass summed over stages and tiles (true scalar
+    multiplies, not chunk-padded slots).
     """
     import numpy as np
 
-    return int(np.asarray(summa_stage_flops(A, B), np.float64).sum())
+    return int(
+        host_value(summa_stage_flops(A, B, padded=False)).astype(np.float64).sum()
+    )
 
 
 def calculate_phases(
@@ -420,7 +453,7 @@ def calculate_phases(
     bytes) against the caller's budget, rounded to a divisor-friendly
     power of two.
     """
-    per_stage = np.asarray(summa_stage_flops(A, B), np.float64)
+    per_stage = host_value(summa_stage_flops(A, B)).astype(np.float64)
     slot_bytes = 4 + 4 + np.dtype(A.dtype).itemsize  # row + col + value
     # Peak per-device expansion follows the ALLOCATED shapes, not the valid
     # entries: summa_spgemm pads every one of the p coexisting stage chunks
@@ -452,7 +485,7 @@ def estimate_nnz_upper(A: SpParMat, B: SpParMat) -> int:
     """
     import numpy as np
 
-    per_stage = np.asarray(summa_stage_flops(A, B), np.float64)
+    per_stage = host_value(summa_stage_flops(A, B)).astype(np.float64)
     per_tile = per_stage.sum(axis=0)
     dense_tile = A.local_rows * B.local_cols
     return int(np.minimum(per_tile, dense_tile).sum())

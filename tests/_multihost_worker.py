@@ -1,0 +1,74 @@
+"""Worker for the 2-process multi-host test (spawned by
+test_multihost.py). Each process owns 4 virtual CPU devices; the global
+mesh spans 8 devices across both processes — the CPU stand-in for the
+reference's `mpirun -np 2` pattern (ReleaseTests/CMakeLists.txt:41+).
+
+Checks replicate-readable results only (a fully-replicated output is
+addressable on every process): SpMV row sums and SpGEMM nnz vs host
+references computed from the same COO.
+"""
+
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=4"
+)
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def main():
+    coord, pid = sys.argv[1], int(sys.argv[2])
+    from combblas_tpu.parallel.multihost import (
+        init_distributed,
+        make_global_grid,
+    )
+
+    nd = init_distributed(
+        coordinator_address=coord, num_processes=2, process_id=pid
+    )
+    assert nd == 8, f"expected 8 global devices, got {nd}"
+    assert jax.process_count() == 2
+
+    import numpy as np
+
+    from combblas_tpu import PLUS_TIMES
+    from combblas_tpu.parallel.spgemm import spgemm
+    from combblas_tpu.parallel.spmat import SpParMat
+    from combblas_tpu.parallel.spmv import dist_spmv
+    from combblas_tpu.parallel.vec import DistVec
+
+    # full grid (2x4) for SpMV
+    grid = make_global_grid(2, 4)
+    assert grid.size == 8
+
+    rng = np.random.default_rng(0)
+    n = 48
+    d = (rng.random((n, n)) < 0.15).astype(np.float32) * (
+        1 + rng.random((n, n)).astype(np.float32)
+    )
+    r, c = np.nonzero(d)
+    A = SpParMat.from_global_coo(grid, r, c, d[r, c], n, n)
+    x = DistVec.from_global(grid, np.arange(n, dtype=np.float32), align="col")
+    y = dist_spmv(PLUS_TIMES, A, x)
+    got = float(jax.device_get(jax.numpy.sum(y.blocks)))
+    expect = float((d @ np.arange(n, dtype=np.float32)).sum())
+    assert abs(got - expect) < 1e-2 * max(abs(expect), 1), (got, expect)
+
+    # square subgrid (2x2) for SUMMA SpGEMM
+    sq = make_global_grid(2, 2)
+    B = SpParMat.from_global_coo(sq, r, c, d[r, c], n, n)
+    C = spgemm(PLUS_TIMES, B, B)
+    got_nnz = int(jax.device_get(C.getnnz()))
+    expect_nnz = int(((d @ d) != 0).sum())
+    assert got_nnz == expect_nnz, (got_nnz, expect_nnz)
+
+    print(f"proc {pid} OK: devices={nd} spmv_sum={got:.1f} nnz={got_nnz}")
+
+
+if __name__ == "__main__":
+    main()

@@ -256,3 +256,52 @@ def test_bfs_batch_compact_diropt_matches(shape):
             d, int(s_), p1.to_global()[:, k],
             l1.to_global().astype(np.int32)[:, k],
         ), k
+
+
+@pytest.mark.parametrize("shape", [(1, 1), (2, 2)])
+def test_validate_bfs_device(shape, rng):
+    """Device-side Graph500 tree validation: clean trees pass, corrupted
+    trees are flagged with the right violation class."""
+    import dataclasses
+
+    from combblas_tpu.models.bfs import bfs_batch, validate_bfs_device
+    from combblas_tpu.parallel.ellmat import EllParMat
+
+    grid = Grid.make(*shape)
+    n = 64
+    d = rng.random((n, n)) < 0.08
+    d = d | d.T
+    np.fill_diagonal(d, 0)
+    rr, cc = np.nonzero(d)
+    E = EllParMat.from_host_coo(
+        grid, rr.astype(np.int64), cc.astype(np.int64),
+        np.ones(len(rr), np.float32), n, n,
+    )
+    deg = np.bincount(rr, minlength=n)
+    srcs = np.flatnonzero(deg > 0)[[0, 2]].astype(np.int32)
+    p, l, _ = bfs_batch(E, jnp.asarray(srcs))
+    v = np.asarray(validate_bfs_device(E, p, l))
+    assert v.shape == (4, 2)
+    assert (v == 0).all(), v
+
+    # corrupt lane 0: point one discovered vertex's parent at a non-neighbor
+    pg = p.to_global().copy()
+    lg = l.to_global().copy()
+    disc = np.flatnonzero((pg[:, 0] >= 0) & (pg[:, 0] != np.arange(n)))
+    victim = int(disc[-1])
+    non_neighbors = np.flatnonzero(~d[victim])
+    bad_parent = int(non_neighbors[0])
+    pg[victim, 0] = bad_parent
+    from combblas_tpu.parallel.vec import DistMultiVec
+
+    p_bad = DistMultiVec.from_global(grid, pg.astype(np.int32), align="row")
+    v2 = np.asarray(validate_bfs_device(E, p_bad, l))
+    assert v2[2, 0] > 0  # tree-edge violation in lane 0
+    assert (v2[:, 1] == 0).all()  # lane 1 untouched
+
+    # corrupt levels: shift a discovered vertex's level by 2
+    lg2 = lg.copy()
+    lg2[victim, 0] = lg2[victim, 0] + 2
+    l_bad = DistMultiVec.from_global(grid, lg2.astype(np.int32), align="row")
+    v3 = np.asarray(validate_bfs_device(E, p, l_bad))
+    assert v3[1, 0] > 0 or v3[3, 0] > 0

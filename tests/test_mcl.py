@@ -248,6 +248,56 @@ def test_mcl_scan_expansion_matches(rng):
     np.testing.assert_array_equal(l1.to_global(), l2.to_global())
 
 
+def test_mcl_chaos_every_matches(rng):
+    """K-iterations-per-sync block loop (zero D2H inside a block) produces
+    the same clustering as the per-iteration-sync loop."""
+    n = 16
+    d = np.zeros((n, n), np.float32)
+    d[:8, :8] = 1.0
+    d[8:, 8:] = 1.0
+    d[7, 8] = d[8, 7] = 0.1
+    np.fill_diagonal(d, 0)
+    grid = Grid.make(2, 2)
+    A = SpParMat.from_dense(grid, d)
+    l1, it1, ch1 = mcl(A, inflation=2.0)
+    l2, it2, ch2 = mcl(A, inflation=2.0, chaos_every=3)
+    np.testing.assert_array_equal(l1.to_global(), l2.to_global())
+    assert ch2 < 1e-3
+    # the block loop may overshoot convergence by up to K-1 iterations
+    assert it1 <= it2 <= it1 + 2
+
+
+def test_mcl_chaos_every_overflow_reroll(rng):
+    """A deliberately tiny initial capacity must trigger the on-device
+    overflow flag and the save-and-reroll path, still converging exactly."""
+    from combblas_tpu.models import mcl as mcl_mod
+
+    n = 12
+    d = np.zeros((n, n), np.float32)
+    d[:6, :6] = 1.0
+    d[6:, 6:] = 1.0
+    np.fill_diagonal(d, 0)
+    d[5, 6] = d[6, 5] = 0.1
+    grid = Grid.make(2, 2)
+    A = SpParMat.from_dense(grid, d)
+    real_caps = mcl_mod._mcl_block_caps
+    calls = {"n": 0}
+
+    def tiny_caps(mat):
+        calls["n"] += 1
+        f, o = real_caps(mat)
+        return (max(f // 16, 4), max(o // 16, 4)) if calls["n"] == 1 else (f, o)
+
+    try:
+        mcl_mod._mcl_block_caps = tiny_caps
+        labels, _, ch = mcl_mod.mcl(A, inflation=2.0, chaos_every=2)
+    finally:
+        mcl_mod._mcl_block_caps = real_caps
+    lab = labels.to_global()
+    assert len(set(lab[:6])) == 1 and len(set(lab[6:])) == 1
+    assert lab[0] != lab[6] and ch < 1e-3
+
+
 def test_mcl_float64_reference_eps(tmp_path):
     """With x64 enabled (fresh interpreter: the flag is global), MCL runs
     in float64 and converges at the reference's eps=1e-4 (MCL.cpp:55) —

@@ -254,3 +254,29 @@ def test_mcl_3d_matches_2d(rng):
     # same partition (labels are canonical smallest-member ids)
     np.testing.assert_array_equal(l2, l3)
     assert len(np.unique(l2)) == 2
+
+
+def test_mcl_3d_chaos_every_matches(rng):
+    """3D K-iterations-per-sync block loop (frozen capacities, on-device
+    chaos/overflow carry) must match the per-iteration-sync 3D path."""
+    from combblas_tpu.models.mcl import mcl
+    from combblas_tpu.parallel.grid import Grid
+    from combblas_tpu.parallel.mesh3d import Grid3D
+    from combblas_tpu.parallel.spmat import SpParMat
+
+    n = 16
+    d = np.zeros((n, n), np.float32)
+    d[:8, :8] = 1.0
+    d[8:, 8:] = 1.0
+    d[7, 8] = d[8, 7] = 0.1
+    np.fill_diagonal(d, 0)
+    g2 = Grid.make(2, 2)
+    A2 = SpParMat.from_dense(g2, d)
+    g3 = Grid3D.make(2, 2, 2)
+    l1, it1, _ = mcl(A2, inflation=2.0, layers=2, grid3=g3)
+    l2, it2, ch2 = mcl(
+        A2, inflation=2.0, layers=2, grid3=g3, chaos_every=3
+    )
+    np.testing.assert_array_equal(l1.to_global(), l2.to_global())
+    assert ch2 < 1e-3
+    assert it1 <= it2 <= it1 + 2

@@ -34,8 +34,11 @@ def test_local_spgemm_plus_times(rng):
     db = random_dense(rng, 9, 10, 0.35)
     a = SpTuples.from_dense(da, capacity=128)
     b = CSR.from_tuples(SpTuples.from_dense(db, capacity=128))
+    from combblas_tpu.ops.spgemm import flops_padded
+
     fl = int(flops(a, b))
-    c = local_spgemm(PLUS_TIMES, a, b, flop_capacity=max(fl, 1), out_capacity=max(fl, 1))
+    flp = int(flops_padded(a, b))
+    c = local_spgemm(PLUS_TIMES, a, b, flop_capacity=max(flp, 1), out_capacity=max(fl, 1))
     np.testing.assert_allclose(np.asarray(c.to_dense()), da @ db, rtol=1e-5, atol=1e-6)
 
 
@@ -44,7 +47,12 @@ def test_local_spgemm_min_plus(rng):
     db = random_dense(rng, 6, 6, 0.5)
     a = SpTuples.from_dense(da, capacity=36)
     b = CSR.from_tuples(SpTuples.from_dense(db, capacity=36))
-    c = local_spgemm(MIN_PLUS, a, b, flop_capacity=64, out_capacity=64)
+    from combblas_tpu.ops.spgemm import flops_padded
+
+    c = local_spgemm(
+        MIN_PLUS, a, b,
+        flop_capacity=int(flops_padded(a, b)), out_capacity=64,
+    )
     expect = np.full((6, 6), np.inf, np.float32)
     for i in range(6):
         for j in range(6):
