@@ -178,24 +178,33 @@ def child(graph_path: str):
     if os.environ.get("BENCH_VALIDATE") == "1":
         # Graph500 tree validation ON DEVICE (verify.c intent) — after the
         # timed section (the readback above already poisoned this process,
-        # so the validation launch is slow but harmless to the timing)
+        # so the validation launch is slow but harmless to the timing).
+        # Validates a LANE SUBSET: the validator's bucket-sweep
+        # intermediates scale with slots x lanes (~46 GB at W=256 on
+        # scale 20 — past HBM), so a handful of lanes is the memory-sane
+        # spot check (BENCH_VALIDATE_LANES, default 4).
         from combblas_tpu.models.bfs import validate_bfs_device
 
         import jax.numpy as jnp
 
+        nl = min(int(os.environ.get("BENCH_VALIDATE_LANES", "4")), len(te))
+
+        def lanes(mv, dtype=None):
+            b = mv.blocks[:, :, :nl]
+            return type(mv)(
+                blocks=b.astype(dtype) if dtype is not None else b,
+                length=mv.length, align=mv.align, grid=mv.grid,
+            )
+
         v = np.asarray(
             jax.device_get(
                 validate_bfs_device(
-                    E, parents,
-                    type(parents)(
-                        blocks=levels.blocks.astype(jnp.int32),
-                        length=levels.length, align=levels.align,
-                        grid=levels.grid,
-                    ),
+                    E, lanes(parents), lanes(levels, jnp.int32)
                 )
             )
         )
         validation = {
+            "lanes_checked": nl,
             "roots_bad": int(v[0].sum()),
             "level_step_bad": int(v[1].sum()),
             "tree_edge_bad": int(v[2].sum()),

@@ -14,6 +14,8 @@ symbolic sizing + one fused launch (no mid-run readbacks).
 APP=cc: FastSV connected components (one while_loop launch).
 APP=lacc: LACC star hooking/shortcutting (one while_loop launch).
 APP=sssp: Bellman-Ford MIN_PLUS fixed point (one while_loop launch).
+APP=sssp_batch: W-source Bellman-Ford chains in ONE program
+(``sssp_batch`` — the same W-lane gather amortization as APP=ppr).
 APP=bc: batched Brandes from BENCH_ROOTS sources (host loop per level —
 the reference's while(fringe.getnnz()) shape; per-level sizing readbacks
 degrade this chip (D2H poison), recorded as-is).
@@ -316,6 +318,51 @@ def bench_sssp():
     )
 
 
+def bench_sssp_batch():
+    """W-source Bellman-Ford in one program (the batched ELL kernel)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from combblas_tpu.models.sssp import sssp_batch
+    from combblas_tpu.parallel.ellmat import EllParMat
+    from combblas_tpu.parallel.grid import Grid
+
+    W = int(os.environ.get("BENCH_ROOTS", "64"))
+    r, c, n = _graph(SCALE)
+    grid = Grid.make(1, 1)
+    rng = np.random.default_rng(0)
+    w = (rng.random(len(r)) + 0.01).astype(np.float32)
+    E = EllParMat.from_host_coo(grid, r, c, w, n, n)
+    deg = np.bincount(r, minlength=n)
+    srcs = jnp.asarray(
+        rng.choice(np.flatnonzero(deg > 0), size=W, replace=False), jnp.int32
+    )
+    dist, it = sssp_batch(E, srcs)
+    jax.block_until_ready(dist.blocks)
+    time.sleep(3)
+    t0 = time.perf_counter()
+    dist, it = sssp_batch(E, srcs)
+    _ = float(jax.device_get(dist.blocks[0, 0, 0]))
+    dt = time.perf_counter() - t0
+    niter = int(jax.device_get(it))
+    print(
+        json.dumps(
+            {
+                "metric": f"sssp_batch{W}_rmat_scale{SCALE}_s",
+                "value": round(dt, 3),
+                "unit": "s",
+                "nnz": len(r),
+                "roots": W,
+                "iters": niter,
+                "MTEPS_aggregate": round(
+                    len(r) * niter * W / dt / 1e6, 1
+                ),
+            }
+        )
+    )
+
+
 def bench_bc():
     import jax
     import numpy as np
@@ -460,6 +507,8 @@ if __name__ == "__main__":
         bench_cc("lacc")
     elif APP == "sssp":
         bench_sssp()
+    elif APP == "sssp_batch":
+        bench_sssp_batch()
     elif APP == "bc":
         bench_bc()
     elif APP == "mcl":
