@@ -9,6 +9,7 @@ fallback so the package works without a toolchain.
 
 from .mm import (
     read_mm,
+    read_mm_distributed,
     read_mm_spmat,
     write_mm,
     read_binary,
@@ -19,6 +20,7 @@ from .mm import (
 
 __all__ = [
     "read_mm",
+    "read_mm_distributed",
     "read_mm_spmat",
     "write_mm",
     "read_binary",

@@ -129,12 +129,7 @@ def read_mm(path, *, expand_symmetric: bool = True, nthreads: int | None = None)
         rows, cols, vals, nrows, ncols, sym = _read_mm_python(path)
 
     if expand_symmetric and sym:
-        off = rows != cols
-        mr, mc = cols[off], rows[off]
-        mv = -vals[off] if sym == 2 else vals[off]
-        rows = np.concatenate([rows, mr])
-        cols = np.concatenate([cols, mc])
-        vals = np.concatenate([vals, mv])
+        rows, cols, vals = _expand_symmetric(rows, cols, vals, sym)
     return rows, cols, vals, nrows, ncols
 
 
@@ -145,6 +140,175 @@ def read_mm_spmat(grid, path, dtype=np.float32, dedup_sr=None, **kw):
     rows, cols, vals, nrows, ncols = read_mm(path, **kw)
     return SpParMat.from_global_coo(
         grid, rows, cols, vals.astype(dtype), nrows, ncols, dedup_sr=dedup_sr
+    )
+
+
+def _expand_symmetric(rows, cols, vals, sym):
+    """Mirror off-diagonal entries for symmetric (1) / skew (2) /
+    hermitian-as-real (3) banners."""
+    off = rows != cols
+    mr, mc = cols[off], rows[off]
+    mv = -vals[off] if sym == 2 else vals[off]
+    return (
+        np.concatenate([rows, mr]),
+        np.concatenate([cols, mc]),
+        np.concatenate([vals, mv]),
+    )
+
+
+def _mm_header_span(path):
+    """(data_offset, nrows, ncols, nnz, pattern, sym) — the byte offset of
+    the first data line plus the parsed size header."""
+    with open(path, "rb") as f:
+        banner = f.readline().decode()
+        assert banner.startswith("%%MatrixMarket"), f"not MatrixMarket: {path}"
+        b = banner.lower()
+        assert "coordinate" in b, "only coordinate (sparse) format supported"
+        pattern = "pattern" in b
+        sym = (
+            2 if "skew-symmetric" in b else 1 if "symmetric" in b
+            else 3 if "hermitian" in b else 0
+        )
+        line = f.readline().decode()
+        while line.startswith("%"):
+            line = f.readline().decode()
+        nrows, ncols, nnz = (int(x) for x in line.split()[:3])
+        return f.tell(), nrows, ncols, nnz, pattern, sym
+
+
+def read_mm_distributed(
+    grid, path, dtype=np.float32, *, expand_symmetric: bool = True,
+    dedup_sr=None,
+):
+    """Multi-PROCESS Matrix Market read: each controller parses only its
+    byte range of the data section, then one on-device two-hop all_to_all
+    routes every tuple to its owner tile.
+
+    The reference's ``ParallelReadMM`` (SpParMat.cpp:3980-4127) splits the
+    file into per-rank byte ranges with the usual newline rule (a range
+    owns a line iff the line STARTS inside it) and exchanges tuples with
+    Alltoallv; this is the same protocol with processes in place of ranks
+    and ``redistribute_coo`` in place of MPI. Single-process, it
+    degenerates to a plain read + device-side distribution.
+
+    Returns an SpParMat on ``grid`` (which must span the global devices).
+    """
+    import jax
+
+    from ..parallel.redistribute import from_device_coo
+
+    data_off, nrows, ncols, _nnz, pattern, sym = _mm_header_span(path)
+    nproc = jax.process_count()
+    me = jax.process_index()
+    if nproc == 1:
+        # degenerate case: the native threaded parser reads the whole
+        # file; only the device-side distribution tail differs
+        rows, cols, vals, nrows, ncols = read_mm(
+            path, expand_symmetric=expand_symmetric
+        )
+    else:
+        fsize = os.path.getsize(path)
+        span = fsize - data_off
+        lo = data_off + (span * me) // nproc
+        hi = data_off + (span * (me + 1)) // nproc
+
+        with open(path, "rb") as f:
+            # newline rule: a range owns a line iff the line STARTS inside
+            # it. Skip a partial first line (the previous range owns it);
+            # when no line starts in the range at all (start >= hi) the
+            # range owns nothing — reading on would duplicate another
+            # range's lines.
+            if me > 0:
+                f.seek(lo - 1)
+                f.readline()
+                start = f.tell()
+            else:
+                start = lo
+                f.seek(start)
+            buf = f.read(max(hi - start, 0))
+            if buf and not buf.endswith(b"\n") and hi < fsize:
+                buf += f.readline()
+
+        import io as _io
+
+        if len(buf.strip()) == 0:
+            rows = np.empty(0, np.int64)
+            cols = np.empty(0, np.int64)
+            vals = np.empty(0, np.float64)
+        elif pattern:
+            data = np.loadtxt(
+                _io.BytesIO(buf), dtype=np.int64, usecols=(0, 1), ndmin=2
+            )
+            rows, cols = data[:, 0] - 1, data[:, 1] - 1
+            vals = np.ones(len(rows), np.float64)
+        else:
+            data = np.loadtxt(
+                _io.BytesIO(buf), dtype=np.float64, usecols=(0, 1, 2),
+                ndmin=2,
+            )
+            rows = data[:, 0].astype(np.int64) - 1
+            cols = data[:, 1].astype(np.int64) - 1
+            vals = data[:, 2]
+
+        if expand_symmetric and sym:
+            rows, cols, vals = _expand_symmetric(rows, cols, vals, sym)
+
+    # My slice of the GRID's devices (a grid may use fewer devices than
+    # the process owns — chunking must follow the grid, not
+    # local_device_count, or entries past grid_devs*chunk never ship)
+    import jax.numpy as jnp
+
+    mesh = grid.mesh
+    darr = mesh.devices  # [pr, pc] device array
+    myslices = {}
+    k = 0
+    for i in range(darr.shape[0]):
+        for j in range(darr.shape[1]):
+            if darr[i, j].process_index == me:
+                myslices[(i, j)] = k
+                k += 1
+    assert k > 0, "grid has no devices on this process (see make_global_grid)"
+    nmine = k
+
+    # agree on a global per-device chunk (shapes must match SPMD-wide)
+    my_chunk = -(-len(rows) // nmine)
+    if nproc > 1:
+        from jax.experimental import multihost_utils
+
+        chunks = multihost_utils.process_allgather(
+            jnp.asarray([my_chunk], jnp.int32)
+        ).reshape(-1)
+        chunk = int(np.max(chunks))
+    else:
+        chunk = my_chunk
+    chunk = max(chunk, 1)
+
+    # pad my entries to [nmine, chunk] (sentinel row = nrows: dropped)
+    pr_ = np.full((nmine * chunk,), nrows, np.int64)
+    pc_ = np.full((nmine * chunk,), ncols, np.int64)
+    pv_ = np.zeros((nmine * chunk,), np.float64)
+    pr_[: len(rows)], pc_[: len(rows)], pv_[: len(rows)] = rows, cols, vals
+
+    def build(arr, dt):
+        full_shape = (darr.shape[0], darr.shape[1], chunk)
+        sharding = grid.tile_sharding()
+
+        def cb(index):
+            # index selects one (i, j) tile slice of the global array
+            i = index[0].start or 0
+            j = index[1].start or 0
+            s = myslices[(i, j)]
+            return np.ascontiguousarray(
+                arr[s * chunk : (s + 1) * chunk].astype(dt)
+            ).reshape(1, 1, chunk)
+
+        return jax.make_array_from_callback(full_shape, sharding, cb)
+
+    gr = build(pr_, np.int32)
+    gc = build(pc_, np.int32)
+    gv = build(pv_, dtype)
+    return from_device_coo(
+        grid, gr, gc, gv, nrows, ncols, dedup_sr=dedup_sr
     )
 
 

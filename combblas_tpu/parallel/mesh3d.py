@@ -725,21 +725,22 @@ def redistribute_coo3d(
         return (
             t.rows[None, None, None], t.cols[None, None, None],
             t.vals[None, None, None], t.nnz[None, None, None],
-            dropped[None, None, None],
+            dropped[None],
         )
 
     r, c, v, n, dropped = jax.shard_map(
         body,
         mesh=grid.mesh,
         in_specs=(TILE3_SPEC,) * 3,
-        out_specs=(TILE3_SPEC,) * 5,
+        # drop count replicated (multi-process-readable), see 2D twin
+        out_specs=(TILE3_SPEC,) * 4 + (P(),),
         check_vma=False,
     )(rows, cols, vals)
     mat = SpParMat3D(
         rows=r, cols=c, vals=v, nnz=n, nrows=int(nrows), ncols=int(ncols),
         split=split, grid=grid,
     )
-    return mat, dropped[0, 0, 0]
+    return mat, dropped[0]
 
 
 def _route_with_retry(route, chunk_cap: int, dest_fanouts, total: int,
@@ -751,10 +752,12 @@ def _route_with_retry(route, chunk_cap: int, dest_fanouts, total: int,
     tile_cap = 1 << max(
         int(np.ceil(np.log2(max(total / ndev * slack, 1)))), 0
     )
+    from .spgemm import host_value
+
     nd = 0
     for _ in range(max_retries + 1):
         mat, dropped = route(stage_cap, tile_cap)
-        nd = int(dropped)
+        nd = int(host_value(dropped))
         if nd == 0:
             return mat
         stage_cap *= 2

@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
+from jax.sharding import PartitionSpec as P
 
 from ..ops.tuples import SpTuples
 from ..semiring import Semiring
@@ -159,20 +160,22 @@ def redistribute_coo(
         dropped = lax.psum(
             lax.psum(drop1 + drop2 + drop3, ROW_AXIS), COL_AXIS
         )
-        return SpParMat._pack_tile(t) + (dropped[None, None],)
+        return SpParMat._pack_tile(t) + (dropped[None],)
 
     r, c, v, n, dropped = jax.shard_map(
         body,
         mesh=grid.mesh,
         in_specs=(TILE_SPEC,) * 3,
-        out_specs=(TILE_SPEC,) * 5,
+        # drop count REPLICATED (P()): every process must be able to read
+        # it whole for the host-side retry decision under multi-process
+        out_specs=(TILE_SPEC,) * 4 + (P(),),
         check_vma=False,
     )(rows, cols, vals)
     mat = SpParMat(
         rows=r, cols=c, vals=v, nnz=n, nrows=int(nrows), ncols=int(ncols),
         grid=grid,
     )
-    return mat, dropped[0, 0]
+    return mat, dropped[0]
 
 
 def from_device_coo(
@@ -201,6 +204,8 @@ def from_device_coo(
     )
     # total tuples = chunk * ndev over ndev tiles → ~chunk per tile.
     tile_cap = 1 << max(int(np.ceil(np.log2(max(chunk * slack, 1)))), 0)
+    from .spgemm import host_value
+
     nd = 0
     for _ in range(max_retries + 1):
         mat, dropped = redistribute_coo(
@@ -208,7 +213,7 @@ def from_device_coo(
             stage_capacity=stage_cap, tile_capacity=tile_cap,
             dedup_sr=dedup_sr,
         )
-        nd = int(dropped)
+        nd = int(host_value(dropped))
         if nd == 0:
             return mat
         stage_cap *= 2

@@ -67,7 +67,38 @@ def main():
     expect_nnz = int(((d @ d) != 0).sum())
     assert got_nnz == expect_nnz, (got_nnz, expect_nnz)
 
-    print(f"proc {pid} OK: devices={nd} spmv_sum={got:.1f} nnz={got_nnz}")
+    # distributed byte-range Matrix Market read (ParallelReadMM analog):
+    # both processes parse disjoint ranges of the same file
+    import tempfile
+
+    from combblas_tpu.io.mm import read_mm_distributed
+
+    path = os.path.join(tempfile.gettempdir(), "mh_worker_graph.mtx")
+    if pid == 0:
+        lines = [f"%%MatrixMarket matrix coordinate real general\n{n} {n} {len(r)}"]
+        lines += [
+            f"{i + 1} {j + 1} {d[i, j]:.6f}" for i, j in zip(r, c)
+        ]
+        with open(path, "w") as f:
+            f.write("\n".join(lines) + "\n")
+    # both processes reach here only after initialize(); sync via a cheap
+    # collective before reading the file process 0 just wrote
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices("mm_file_written")
+    M = read_mm_distributed(grid, path)
+    got_sum = float(jax.device_get(jax.numpy.sum(M.vals)))
+    expect_sum = float(np.round(d[r, c], 6).sum())
+    assert abs(got_sum - expect_sum) < 1e-2 * max(abs(expect_sum), 1), (
+        got_sum, expect_sum,
+    )
+    got_mm_nnz = int(jax.device_get(M.getnnz()))
+    assert got_mm_nnz == len(r), (got_mm_nnz, len(r))
+
+    print(
+        f"proc {pid} OK: devices={nd} spmv_sum={got:.1f} nnz={got_nnz} "
+        f"mm_nnz={got_mm_nnz}"
+    )
 
 
 if __name__ == "__main__":
