@@ -16,6 +16,8 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 SCALE = int(os.environ.get("BENCH_SCALE", "18"))
 REPS = int(os.environ.get("BENCH_REPS", "8"))
+LADDER = os.environ.get("BENCH_LADDER", "fine")  # fine | coarse (1-lane
+# payloads favor coarse: fewer bucket classes, see _width_ladder)
 
 
 def main():
@@ -36,7 +38,7 @@ def main():
     uniq = np.unique(key)
     ru, cu = uniq // n, uniq % n
     E = EllParMat.from_host_coo(
-        grid, ru, cu, np.ones(len(ru), np.float32), n, n
+        grid, ru, cu, np.ones(len(ru), np.float32), n, n, ladder=LADDER
     )
     x = DistVec.from_global(
         grid, np.random.default_rng(0).random(n).astype(np.float32),
@@ -74,7 +76,7 @@ def main():
     print(
         json.dumps(
             {
-                "metric": f"spmv_ell_rmat_scale{SCALE}_chained_GFLOPs",
+                "metric": f"spmv_ell{LADDER}_rmat_scale{SCALE}_chained_GFLOPs",
                 "value": round(gflops, 3),
                 "unit": "GFLOP/s",
                 "nnz": int(len(ru)),

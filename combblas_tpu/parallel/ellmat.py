@@ -88,7 +88,7 @@ class EllParMat:
     @staticmethod
     def from_host_coo(
         grid: Grid, rows, cols, vals, nrows: int, ncols: int,
-        max_k: int | None = None,
+        max_k: int | None = None, ladder: str = "fine",
     ) -> "EllParMat":
         """Build directly from host global COO — fully numpy + one upload
         (the only safe construction path for real-chip benchmarking; see
@@ -97,6 +97,12 @@ class EllParMat:
         ``max_k`` caps a bucket's width; rows with degree > max_k span
         multiple bucket rows whose partial folds recombine in the result
         scatter via the semiring add (each entry still appears once).
+
+        ``ladder``: ``"fine"`` (default) uses the 1.5-step width ladder —
+        ~1.15x slot padding, +12% on W=256 batched BFS; ``"coarse"`` uses
+        power-of-two widths — FEWER bucket classes (fewer small gathers
+        per sweep), measurably better for 1-lane payloads (single-vector
+        SpMV) which cannot amortize the extra per-bucket sweeps.
         """
         from .spmat import bucket_by_tile
 
@@ -111,7 +117,7 @@ class EllParMat:
 
         # Per tile: row-sort, then vectorized chunking of every nonempty row
         # into (class, row, start, take) with take <= max_k.
-        ladder = _width_ladder(max_k)
+        ladder = _width_ladder(max_k, ladder)
         per_tile = []
         classes = set()
         for t in range(grid.size):
@@ -136,9 +142,8 @@ class EllParMat:
             chunk = np.arange(len(rep_row)) - base
             take = np.minimum(rep_deg - chunk * max_k, max_k).astype(np.int64)
             start = rep_start + chunk * max_k
-            # 1.5-step width ladder: average padding ~1.15x instead of
-            # the pure-power-of-two ladder's ~1.34x — the ELL gather
-            # count IS the dense-level cost, so slot padding is overhead
+            # width-class the chunk (fine ladder: ~1.15x average slot
+            # padding; coarse: ~1.34x but fewer bucket sweeps)
             cls = np.searchsorted(ladder, take)
             classes.update(np.unique(cls).tolist())
             per_tile.append((cls, rep_row, start, take, c, v))
@@ -174,7 +179,9 @@ class EllParMat:
         )
 
     @staticmethod
-    def from_spmat(A: SpParMat, max_k: int | None = None) -> "EllParMat":
+    def from_spmat(
+        A: SpParMat, max_k: int | None = None, ladder: str = "fine"
+    ) -> "EllParMat":
         """Host conversion from an existing SpParMat (one-time per matrix —
         the kernel-1 pre-pass, like the reference's OptimizeForGraph500,
         SpParMat.cpp:3343). NOTE: reads the tiles back to host; on the axon
@@ -182,7 +189,7 @@ class EllParMat:
         """
         r, c, v = A.to_global_coo()
         return EllParMat.from_host_coo(
-            A.grid, r, c, v, A.nrows, A.ncols, max_k=max_k
+            A.grid, r, c, v, A.nrows, A.ncols, max_k=max_k, ladder=ladder
         )
 
     def reduce(self, sr: Semiring, axis: str, map_fn=None) -> DistVec:
@@ -193,13 +200,24 @@ class EllParMat:
         return _ell_reduce_rows_jit(self, sr, map_fn)
 
 
-def _width_ladder(max_k: int) -> "np.ndarray":
-    """Bucket widths 1,2,3,4,6,8,12,... clamped to include max_k:
-    alternating x1.5 (2^k → 3·2^(k-1)) and x4/3 (→ 2^(k+1)) steps."""
-    widths = [1, 2]
-    while widths[-1] < max_k:
-        n = widths[-1]
-        widths.append(n * 3 // 2 if (n & (n - 1)) == 0 else n * 4 // 3)
+def _width_ladder(max_k: int, kind: str = "fine") -> "np.ndarray":
+    """Bucket widths clamped to include max_k.
+
+    "fine": 1,2,3,4,6,8,12,... — alternating x1.5 (2^k → 3·2^(k-1)) and
+    x4/3 (→ 2^(k+1)) steps, ~1.15x average slot padding.
+    "coarse": powers of two — ~1.34x padding but ~half the bucket
+    classes (fewer per-sweep gathers; better for 1-lane payloads)."""
+    if kind not in ("fine", "coarse"):
+        raise ValueError(f"ladder must be 'fine' or 'coarse', got {kind!r}")
+    if kind == "coarse":
+        widths = [1]
+        while widths[-1] < max_k:
+            widths.append(widths[-1] * 2)
+    else:
+        widths = [1, 2]
+        while widths[-1] < max_k:
+            n = widths[-1]
+            widths.append(n * 3 // 2 if (n & (n - 1)) == 0 else n * 4 // 3)
     widths = [w for w in widths if w <= max_k]
     if not widths or widths[-1] != max_k:
         widths.append(max_k)

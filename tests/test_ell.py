@@ -77,3 +77,25 @@ def test_ell_row_degrees(rng):
     E = EllParMat.from_spmat(A, max_k=2)  # force hub-row splitting
     got = E.reduce(PLUS_TIMES, "cols", map_fn=ones_i32).to_global()
     np.testing.assert_array_equal(got, (d != 0).sum(axis=1))
+
+
+def test_coarse_ladder_matches_fine(rng):
+    """ladder='coarse' (power-of-two widths) computes identical SpMV."""
+    from combblas_tpu.parallel.ellmat import dist_spmv_ell
+
+    grid = Grid.make(2, 2)
+    n = 64
+    d = ((rng.random((n, n)) < 0.15) * rng.random((n, n))).astype(np.float32)
+    r, c = np.nonzero(d)
+    x = rng.random(n).astype(np.float32)
+    xv = DistVec.from_global(grid, x, align="col")
+    outs = []
+    for lad in ("fine", "coarse"):
+        E = EllParMat.from_host_coo(
+            grid, r.astype(np.int64), c.astype(np.int64),
+            d[r, c], n, n, ladder=lad,
+        )
+        y = dist_spmv_ell(PLUS_TIMES, E, xv)
+        outs.append(np.asarray(y.to_global()))
+    np.testing.assert_allclose(outs[0], outs[1], rtol=1e-5)
+    np.testing.assert_allclose(outs[0], d @ x, rtol=1e-4, atol=1e-5)
