@@ -403,6 +403,50 @@ def bench_bc():
     )
 
 
+def bench_bc_dense():
+    """One-launch dense batched Brandes (bc_batch_dense) — the TPU-native
+    BC: zero readbacks, W sources per program."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from combblas_tpu.models.bc import bc_batch_dense
+    from combblas_tpu.parallel.ellmat import EllParMat
+    from combblas_tpu.parallel.grid import Grid
+
+    W = int(os.environ.get("BENCH_ROOTS", "16"))
+    r, c, n = _graph(SCALE, ef=8)
+    grid = Grid.make(1, 1)
+    E = EllParMat.from_host_coo(
+        grid, r, c, np.ones(len(r), np.float32), n, n
+    )
+    rng = np.random.default_rng(0)
+    deg = np.bincount(r, minlength=n)
+    srcs = jnp.asarray(
+        rng.choice(np.flatnonzero(deg > 0), size=W, replace=False), jnp.int32
+    )
+    # static depth bound: R-MAT diameters are tiny; 64 is generous
+    scores = bc_batch_dense(E, E, srcs, max_depth=64)
+    jax.block_until_ready(scores.blocks)
+    time.sleep(3)
+    t0 = time.perf_counter()
+    scores = bc_batch_dense(E, E, srcs, max_depth=64)
+    _ = float(jax.device_get(scores.blocks[0, 0]))
+    dt = time.perf_counter() - t0
+    print(
+        json.dumps(
+            {
+                "metric": f"bc_dense{W}_rmat_scale{SCALE}_s",
+                "value": round(dt, 2),
+                "unit": "s",
+                "nnz": len(r),
+                "roots": W,
+                "s_per_root": round(dt / W, 3),
+            }
+        )
+    )
+
+
 def bench_mcl():
     """BENCH_ITERS MCL iterations in ONE launch, frozen host-sized caps."""
     import jax
@@ -511,6 +555,8 @@ if __name__ == "__main__":
         bench_sssp_batch()
     elif APP == "bc":
         bench_bc()
+    elif APP == "bc_dense":
+        bench_bc_dense()
     elif APP == "mcl":
         bench_mcl()
     else:

@@ -21,6 +21,9 @@ Backward (Brandes dependency):
 
 from __future__ import annotations
 
+from functools import partial
+
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -71,8 +74,13 @@ def bc_batch(A: SpParMat, sources, AT: SpParMat | None = None) -> DistVec:
     levels: list[SpParMat] = [fringe]
     # Forward sweep (host loop: depth is data-dependent, as in the
     # reference's while(fringe.getnnz() > 0), BetwCent.cpp:179).
+    # Orientation: A[i,j] != 0 is edge j->i (the BFS convention), so path
+    # counts PULL from predecessors via A; the backward dependency sweep
+    # pulls from successors via AT. (Round-2 had these swapped — invisible
+    # on symmetric graphs, wrong on directed ones; caught by the
+    # bc_batch_dense cross-check against textbook Brandes.)
     while True:
-        fringe = spgemm(PLUS_TIMES, AT, fringe)
+        fringe = spgemm(PLUS_TIMES, A, fringe)
         fringe = nsp.filter_spmat(fringe, _keep_unsettled)
         if int(fringe.getnnz()) == 0:
             break
@@ -87,7 +95,7 @@ def bc_batch(A: SpParMat, sources, AT: SpParMat | None = None) -> DistVec:
     for d in range(len(levels) - 1, 0, -1):
         ratio = delta.ewise(nsp, _one_plus_a_over_b)
         w = ratio.scale_spmat(levels[d], _replace_with_dense)
-        contrib = spgemm(PLUS_TIMES, A, w)
+        contrib = spgemm(PLUS_TIMES, AT, w)
         upd = contrib.ewise_mult(levels[d - 1], combine=_mul_combine)
         delta = delta.add_spmat(upd)
     total = delta.reduce(PLUS_TIMES, "cols")
@@ -128,3 +136,80 @@ def betweenness_centrality(
     if normalize:
         acc = acc.apply(lambda b: b * 0.5)
     return acc
+
+
+@partial(jax.jit, static_argnames=("max_depth",))
+def bc_batch_dense(E, ET, sources, max_depth: int | None = None):
+    """Batched Brandes in ONE compiled program over dense [n, W] state.
+
+    The host-loop ``bc_batch`` mirrors the reference's
+    ``while(fringe.getnnz())`` shape (BetwCent.cpp:179) — per-level SpGEMM
+    sizing readbacks, which are launch-poison on the target chip. This
+    variant is the TPU-native redesign: levels and path counts live as
+    dense [n, W] lanes (the batched-BFS state layout), every sweep step is
+    one multi-lane ELL SpMV, and both sweeps run under ``lax`` control
+    flow — zero device→host readbacks.
+
+    ``E``: adjacency with entry (i, j) = edge j→i (the BFS gather
+    orientation); ``ET``: its transpose (pass the same EllParMat for
+    symmetric graphs). ``sources``: [W] int32. Returns the row-aligned
+    partial BC DistVec (dependency sums over these W sources, endpoints
+    excluded per Brandes).
+    """
+    from ..parallel.ellmat import dist_spmv_ell_multi
+    from ..parallel.vec import DistMultiVec
+
+    grid = E.grid
+    n = E.nrows
+    W = sources.shape[0]
+    D = max_depth if max_depth is not None else n
+
+    gids = DistVec.iota(grid, n, jnp.int32, align="row").blocks  # [pr, lr]
+    is_src = gids[..., None] == sources[None, None, :]
+    lvl0 = jnp.where(is_src, 0, -1).astype(jnp.int32)
+    nsp0 = is_src.astype(E.dtype)
+
+    def mk(blocks):
+        return DistMultiVec(blocks=blocks, length=n, align="row", grid=grid)
+
+    def fcond(st):
+        d, _, _, active = st
+        return active & (d < D)
+
+    def fstep(st):
+        d, lvl, nsp, _ = st
+        frontier = jnp.where(lvl == d, nsp, 0)
+        arriving = dist_spmv_ell_multi(PLUS_TIMES, E, mk(frontier)).blocks
+        new = (arriving > 0) & (lvl < 0)
+        lvl = jnp.where(new, d + 1, lvl)
+        nsp = nsp + jnp.where(new, arriving, 0)
+        return d + 1, lvl, nsp, jnp.any(new)
+
+    depth, lvl, nsp, _ = jax.lax.while_loop(
+        fcond, fstep, (jnp.int32(0), lvl0, nsp0, jnp.bool_(True))
+    )
+
+    # Backward dependency sweep: d = depth ... 1; every level-(d) vertex
+    # w exports (1+delta[w])/nsp[w]; level-(d-1) predecessors v collect it
+    # along their out-edges and scale by nsp[v]. Starting at d = depth
+    # (one past the last level on natural exit — a no-op there) keeps the
+    # deepest level's exports when the max_depth bound cut the forward
+    # sweep short; the loop bound is the TRACED depth, so only the real
+    # levels run (fori_loop lowers a traced bound to a while_loop).
+    def bstep(k, delta):
+        d = depth - k
+        wmask = (lvl == d) & (nsp > 0)
+        w = jnp.where(
+            wmask, (1.0 + delta) / jnp.maximum(nsp, 1e-30), 0
+        ).astype(E.dtype)
+        collected = dist_spmv_ell_multi(PLUS_TIMES, ET, mk(w)).blocks
+        upd = jnp.where(lvl == d - 1, collected * nsp, 0)
+        return delta + upd
+
+    delta = jax.lax.fori_loop(
+        0, depth, bstep, jnp.zeros_like(nsp0)
+    )
+    # endpoints excluded: zero each lane's own source slot, sum lanes
+    delta = jnp.where(is_src, 0, delta)
+    total = jnp.sum(delta, axis=-1)
+    return DistVec(blocks=total, length=n, align="row", grid=grid)

@@ -97,3 +97,69 @@ def test_bc_sampled_sources(rng):
     np.testing.assert_allclose(
         got, brandes_numpy(d, srcs), rtol=1e-4, atol=1e-4
     )
+
+
+def test_bc_batch_dense_matches_host_loop(rng):
+    """The one-launch dense Brandes == the host-loop bc_batch."""
+    import jax.numpy as jnp
+
+    from combblas_tpu.models.bc import bc_batch, bc_batch_dense
+    from combblas_tpu.parallel.ellmat import EllParMat
+    from combblas_tpu.parallel.grid import Grid
+    from combblas_tpu.parallel.spmat import SpParMat
+
+    grid = Grid.make(2, 2)
+    n = 32
+    d = (rng.random((n, n)) < 0.12)
+    d = (d | d.T).astype(np.float32)
+    np.fill_diagonal(d, 0)
+    r, c = np.nonzero(d)
+    A = SpParMat.from_global_coo(grid, r, c, d[r, c], n, n)
+    E = EllParMat.from_host_coo(
+        grid, r.astype(np.int64), c.astype(np.int64), d[r, c], n, n
+    )
+    srcs = np.array([0, 5, 11, 20], np.int64)
+    ref = bc_batch(A, srcs).to_global()
+    got = bc_batch_dense(
+        E, E, jnp.asarray(srcs, jnp.int32)
+    ).to_global()
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_bc_batch_dense_directed_and_depth_bound(rng):
+    """Directed graph with distinct E/ET, plus a max_depth exactly at the
+    diameter (the truncation edge the backward sweep must still cover)."""
+    import jax.numpy as jnp
+
+    from combblas_tpu.models.bc import bc_batch, bc_batch_dense
+    from combblas_tpu.parallel.ellmat import EllParMat
+    from combblas_tpu.parallel.grid import Grid
+    from combblas_tpu.parallel.spmat import SpParMat
+
+    grid = Grid.make(2, 2)
+    n = 16
+    # directed path 0->1->...->7 plus random extra arcs
+    d = np.zeros((n, n), np.float32)
+    for v in range(7):
+        d[v + 1, v] = 1.0  # edge v -> v+1 in (i,j)=j->i convention
+    extra = rng.random((n, n)) < 0.05
+    d = np.maximum(d, extra.astype(np.float32))
+    np.fill_diagonal(d, 0)
+    r, c = np.nonzero(d)
+    A = SpParMat.from_global_coo(grid, r, c, d[r, c], n, n)
+    E = EllParMat.from_host_coo(
+        grid, r.astype(np.int64), c.astype(np.int64), d[r, c], n, n
+    )
+    rt, ct = c, r  # transpose
+    ET = EllParMat.from_host_coo(
+        grid, rt.astype(np.int64), ct.astype(np.int64), d[r, c], n, n
+    )
+    srcs = np.array([0, 3], np.int64)
+    ref = bc_batch(A, srcs).to_global()
+    got = bc_batch_dense(E, ET, jnp.asarray(srcs, jnp.int32)).to_global()
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+    # max_depth exactly at the deepest discovered level from source 0
+    got_tight = bc_batch_dense(
+        E, ET, jnp.asarray(srcs, jnp.int32), max_depth=7
+    ).to_global()
+    np.testing.assert_allclose(got_tight, ref, rtol=1e-4, atol=1e-4)
