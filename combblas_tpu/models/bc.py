@@ -8,15 +8,20 @@ level fringes with elementwise rescales. This is parallelism strategy #7 of
 SURVEY §2.3 — batch parallelism over sources — and it maps perfectly to the
 TPU: the batch dimension widens every kernel, feeding the MXU/VPU lanes.
 
-Forward, per level d (host loop, like the reference's):
-    fringe ← Aᵀ ⊗ fringe            (SUMMA on the n × batch fringe)
+Forward, per level d (host loop, like the reference's; orientation:
+A[i,j] != 0 is edge j→i, the BFS convention, so path counts PULL from
+predecessors via A and dependencies pull from successors via Aᵀ):
+    fringe ← A ⊗ fringe             (SUMMA on the n × batch fringe)
     fringe ← fringe .!(nsp > 0)     (drop already-settled vertices)
     nsp    ← nsp + fringe           (dense accumulate of path counts)
 Backward (Brandes dependency):
     w      ← fringe_d .* (1 + delta)/nsp     (dense-indexed rescale)
-    contrib← A ⊗ w
+    contrib← Aᵀ ⊗ w
     delta  ← delta + (contrib .* fringe_{d-1}) * nsp_{d-1}
     bc     ← bc + Σ_batch delta
+
+``bc_batch_dense`` is the one-launch redesign: dense [n, W] level/path
+lanes, both sweeps under lax control flow, zero readbacks.
 """
 
 from __future__ import annotations
@@ -185,7 +190,7 @@ def bc_batch_dense(E, ET, sources, max_depth: int | None = None):
         nsp = nsp + jnp.where(new, arriving, 0)
         return d + 1, lvl, nsp, jnp.any(new)
 
-    depth, lvl, nsp, _ = jax.lax.while_loop(
+    depth, lvl, nsp, still_active = jax.lax.while_loop(
         fcond, fstep, (jnp.int32(0), lvl0, nsp0, jnp.bool_(True))
     )
 
@@ -206,8 +211,12 @@ def bc_batch_dense(E, ET, sources, max_depth: int | None = None):
         upd = jnp.where(lvl == d - 1, collected * nsp, 0)
         return delta + upd
 
+    # on natural exit level `depth` is empty (the last step found
+    # nothing) — skip its guaranteed no-op SpMV; when the max_depth bound
+    # cut the sweep short (still_active), level `depth` is real
+    start = jnp.where(still_active, 0, 1)
     delta = jax.lax.fori_loop(
-        0, depth, bstep, jnp.zeros_like(nsp0)
+        start, depth, bstep, jnp.zeros_like(nsp0)
     )
     # endpoints excluded: zero each lane's own source slot, sum lanes
     delta = jnp.where(is_src, 0, delta)

@@ -270,6 +270,9 @@ def test_mcl_chaos_every_matches(rng):
 def test_mcl_chaos_every_overflow_reroll(rng):
     """A deliberately tiny initial capacity must trigger the on-device
     overflow flag and the save-and-reroll path, still converging exactly."""
+    import jax
+
+    jax.clear_caches()  # many reroll compiles; see test_mcl_3d_chaos_every
     from combblas_tpu.models import mcl as mcl_mod
 
     n = 12
