@@ -538,6 +538,106 @@ def bench_mcl():
     )
 
 
+def bench_mcl_dense():
+    """Round-4 dense one-launch MCL: the WHOLE clustering loop as one
+    lax.while_loop on the MXU (models/mcl.py:dense_mcl_program).
+
+    Protocol: AOT-compile (lower().compile() — no warmup EXECUTION, so no
+    pre-timing readback poisons the run), one timed execution closed by
+    the iteration-count readback.  No capacities exist in this
+    formulation, so overflow is structurally 0; the chaos trajectory is
+    carried on device and reported per iteration.
+    """
+    import jax
+    import numpy as np
+
+    from combblas_tpu.models.mcl import dense_mcl_program
+    from combblas_tpu.parallel.grid import Grid
+    from combblas_tpu.parallel.spmat import SpParMat
+    from combblas_tpu.models.mcl import make_col_stochastic
+
+    K = ITERS
+    SELECT = int(os.environ.get("BENCH_SELECT", "64"))
+    MODE = os.environ.get("BENCH_DENSE_MODE", "bf16x3")
+    r, c, n = _graph(SCALE, ef=8)
+    grid = Grid.make(1, 1)
+    diag = np.arange(n, dtype=np.int64)
+    r = np.concatenate([r, diag])
+    c = np.concatenate([c, diag])
+    A = SpParMat.from_global_coo(
+        grid, r, c, np.ones(len(r), np.float32), n, n
+    )
+    A = make_col_stochastic(A)
+    run = dense_mcl_program(
+        n, n, 2.0, 1e-3, K,
+        hard=1e-4, select=min(SELECT, n),
+        recover=min(SELECT + SELECT // 4, n),
+        rpct=0.9, mode=MODE,
+    )
+    rows, cols, vals = A.rows[0, 0], A.cols[0, 0], A.vals[0, 0]
+    compiled = jax.jit(run).lower(rows, cols, vals).compile()
+    time.sleep(2)
+    t0 = time.perf_counter()
+    m, it, ch, hist = compiled(rows, cols, vals)
+    iters = int(jax.device_get(it))  # the closing readback
+    dt = time.perf_counter() - t0
+    ch_v = float(jax.device_get(ch))
+    hist_v = np.asarray(jax.device_get(hist))[:iters]
+    print(
+        json.dumps(
+            {
+                "metric": f"mcl_dense_rmat_scale{SCALE}_s_per_iter",
+                "value": round(dt / max(iters, 1), 3),
+                "unit": "s/iter",
+                "total_s": round(dt, 3),
+                "iters": iters,
+                "converged": bool(ch_v < 1e-3),
+                "nnz": len(r),
+                "chaos": round(ch_v, 6),
+                "chaos_trajectory": [round(float(x), 5) for x in hist_v],
+                "overflow": 0,
+                "select": SELECT,
+                "mode": MODE,
+            }
+        )
+    )
+
+
+def bench_tc_dense():
+    """Round-4 one-launch MXU triangle count (models/tc.py:_tc_dense):
+    AOT-compile, one timed execution, readback closes the window."""
+    import jax
+    import numpy as np
+
+    from combblas_tpu.models.tc import _tc_dense
+    from combblas_tpu.parallel.grid import Grid
+    from combblas_tpu.parallel.spmat import SpParMat
+
+    r, c, n = _graph(SCALE, ef=8)
+    grid = Grid.make(1, 1)
+    A = SpParMat.from_global_coo(
+        grid, r, c, np.ones(len(r), np.float32), n, n
+    )
+    rows, cols = A.rows[0, 0], A.cols[0, 0]
+    fn = jax.jit(_tc_dense, static_argnums=2)
+    compiled = fn.lower(rows, cols, n).compile()
+    time.sleep(2)
+    t0 = time.perf_counter()
+    n_tri = int(jax.device_get(compiled(rows, cols)))
+    dt = time.perf_counter() - t0
+    print(
+        json.dumps(
+            {
+                "metric": f"tc_dense_rmat_scale{SCALE}_s",
+                "value": round(dt, 3),
+                "unit": "s",
+                "triangles": n_tri,
+                "nnz": len(r),
+            }
+        )
+    )
+
+
 if __name__ == "__main__":
     if APP == "pagerank":
         bench_pagerank()
@@ -559,5 +659,9 @@ if __name__ == "__main__":
         bench_bc_dense()
     elif APP == "mcl":
         bench_mcl()
+    elif APP == "mcl_dense":
+        bench_mcl_dense()
+    elif APP == "tc_dense":
+        bench_tc_dense()
     else:
         raise SystemExit(f"unknown BENCH_APP {APP}")

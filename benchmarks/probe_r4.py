@@ -1,0 +1,699 @@
+"""Round-4 single-experiment probes (axon-safe, one experiment per process).
+
+Usage:  python benchmarks/probe_r4.py EXPERIMENT [ARGS...]
+
+Same protocol as instrument.py: fresh process, one warmup + sleep drain,
+ONE timed section closed by a single scalar D2H, one JSON line on stdout.
+
+The round-4 question is how to break the ~22 M/s per-element random-memory
+wall for SpGEMM accumulation (VERDICT r3 item 1). Candidate escape routes,
+one probe each:
+
+  mxu DT N R        dense [N,N]x[N,N] matmul rate with dtype DT in
+                    {bf16, f32} (accumulate f32). If bf16 runs at tens of
+                    TFLOP/s, DENSE blocked A^2 beats any sparse formulation
+                    at bench scales (n=16K..64K) outright.
+  mxu3 N R          bf16x3 split-float matmul (hi/lo decomposition, 3
+                    bf16 matmuls ~ f32 precision): the precision-restoring
+                    variant of the dense path.
+  pdma MB R         Pallas double-buffered HBM->VMEM->HBM copy bandwidth
+                    (is the XLA-measured 11 GB/s "streaming" a chip limit
+                    or an XLA artifact?).
+  pscat T N R       Pallas scalar scatter-accumulate: fori_loop of
+                    acc[idx[i]] += val[i] into a T-KB VMEM table, N random
+                    indices streamed from HBM. The rate bound for any
+                    VMEM-resident accumulation kernel.
+  pscatv T N R      same, but 8-way vectorized attempt: load 8 idx/vals as
+                    a vector, 8 scalar updates per loop step (amortizes
+                    loop overhead).
+  densepath SCALE   end-to-end dense A^2 at SCALE: sparse->dense scatter
+                    (bf16), matmul f32-accum, nnz count of result. The
+                    realistic dense-SpGEMM number including conversions.
+  cumsum2d M N R    row-wise cumsum over [M,N] f32 (the dense->sparse
+                    extraction primitive).
+  topk M N K R      lax.top_k(k=K) per row over [M,N] (the dense MCL prune
+                    primitive).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+
+def timed_once(run, sync):
+    t0 = time.perf_counter()
+    out = run()
+    sync(out)
+    return time.perf_counter() - t0
+
+
+def exp_mxu(dt: str, N: int, R: int):
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    dtype = {"bf16": jnp.bfloat16, "f32": jnp.float32}[dt]
+    a = jax.device_put(jnp.ones((N, N), dtype))
+    b = jax.device_put(jnp.ones((N, N), dtype))
+
+    @jax.jit
+    def run(a, b):
+        def body(_, carry):
+            c = jnp.dot(a, carry.astype(dtype),
+                        preferred_element_type=jnp.float32)
+            return c * (1.0 / N)  # keep values bounded across iterations
+        return lax.fori_loop(0, R, body, b.astype(jnp.float32))
+
+    out = run(a, b)
+    jax.block_until_ready(out)
+    time.sleep(3.0)
+    dt_s = timed_once(lambda: run(a, b), lambda o: float(jax.device_get(o[0, 0])))
+    flops = 2.0 * N * N * N * R
+    return {
+        "experiment": f"mxu {dt} N={N} R={R}",
+        "dt_s": round(dt_s, 4),
+        "TFLOPs": round(flops / dt_s / 1e12, 2),
+    }
+
+
+def exp_mxu3(N: int, R: int):
+    """Split-float bf16x3: a = hi + lo, c = hi@hi + hi@lo + lo@hi."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    a = jax.device_put(jnp.ones((N, N), jnp.float32))
+
+    def split(x):
+        hi = x.astype(jnp.bfloat16)
+        lo = (x - hi.astype(jnp.float32)).astype(jnp.bfloat16)
+        return hi, lo
+
+    @jax.jit
+    def run(a):
+        def body(_, carry):
+            ah, al = split(a)
+            bh, bl = split(carry)
+            c = (jnp.dot(ah, bh, preferred_element_type=jnp.float32)
+                 + jnp.dot(ah, bl, preferred_element_type=jnp.float32)
+                 + jnp.dot(al, bh, preferred_element_type=jnp.float32))
+            return c * (1.0 / N)
+        return lax.fori_loop(0, R, body, a)
+
+    out = run(a)
+    jax.block_until_ready(out)
+    time.sleep(3.0)
+    dt_s = timed_once(lambda: run(a), lambda o: float(jax.device_get(o[0, 0])))
+    flops = 2.0 * N * N * N * R  # logical flops (not the 3x physical)
+    return {
+        "experiment": f"mxu3 N={N} R={R}",
+        "dt_s": round(dt_s, 4),
+        "logical_TFLOPs": round(flops / dt_s / 1e12, 2),
+    }
+
+
+def exp_pdma(mb: int, R: int):
+    """Pallas grid-pipelined copy: HBM -> VMEM -> HBM, [n, 512] f32 blocks.
+
+    The automatic BlockSpec pipeline double-buffers DMA; measures what
+    bandwidth Pallas can actually move (vs the XLA-level 11 GB/s)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n = mb * 1024 * 1024 // 4 // 512
+    x = jax.device_put(jnp.ones((n, 512), jnp.float32))
+    BR = 1024
+
+    def kernel(x_ref, o_ref):
+        o_ref[...] = x_ref[...] + 1.0
+
+    def copy(x):
+        return pl.pallas_call(
+            kernel,
+            grid=(n // BR,),
+            in_specs=[pl.BlockSpec((BR, 512), lambda i: (i, 0),
+                                   memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec((BR, 512), lambda i: (i, 0),
+                                   memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((n, 512), jnp.float32),
+        )(x)
+
+    @jax.jit
+    def run(x):
+        def body(_, carry):
+            return copy(carry)
+        return lax.fori_loop(0, R, body, x)
+
+    out = run(x)
+    jax.block_until_ready(out)
+    time.sleep(3.0)
+    dt_s = timed_once(lambda: run(x), lambda o: float(jax.device_get(o[0, 0])))
+    bytes_moved = 2.0 * n * 512 * 4 * R  # read + write
+    return {
+        "experiment": f"pdma {mb}MB R={R}",
+        "dt_s": round(dt_s, 4),
+        "GBps": round(bytes_moved / dt_s / 1e9, 2),
+    }
+
+
+def _pscat_common(tkb: int, n_idx: int, R: int, vec_w: int):
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    tsize = tkb * 1024 // 4
+    rng = np.random.default_rng(0)
+    idx_h = rng.integers(0, tsize, size=n_idx).astype(np.int32)
+    idx = jax.device_put(jnp.asarray(idx_h))
+    vals = jax.device_put(jnp.ones((n_idx,), jnp.float32))
+
+    # table as [tsize//128, 128] (2D for TPU); idx decomposed as (row, col)
+    trows = tsize // 128
+
+    def kernel(idx_ref, val_ref, o_ref, acc_ref):
+        @pl.when(pl.program_id(0) == 0)
+        def _():
+            acc_ref[...] = jnp.zeros_like(acc_ref)
+
+        nloc = idx_ref.shape[0]
+
+        def body(i, _):
+            if vec_w == 1:
+                ix = idx_ref[i]
+                r, c = ix // 128, ix % 128
+                acc_ref[r, c] += val_ref[i]
+            else:
+                for u in range(vec_w):
+                    ix = idx_ref[i * vec_w + u]
+                    r, c = ix // 128, ix % 128
+                    acc_ref[r, c] += val_ref[i * vec_w + u]
+            return 0
+
+        lax.fori_loop(0, nloc // vec_w, body, 0)
+
+        @pl.when(pl.program_id(0) == pl.num_programs(0) - 1)
+        def _():
+            o_ref[...] = acc_ref[...]
+
+    CH = 131072
+
+    def scat(idx, vals):
+        return pl.pallas_call(
+            kernel,
+            grid=(n_idx // CH,),
+            in_specs=[
+                pl.BlockSpec((CH,), lambda i: (i,), memory_space=pltpu.VMEM),
+                pl.BlockSpec((CH,), lambda i: (i,), memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((trows, 128), lambda i: (0, 0),
+                                   memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((trows, 128), jnp.float32),
+            scratch_shapes=[pltpu.VMEM((trows, 128), jnp.float32)],
+        )(idx, vals)
+
+    @jax.jit
+    def run(idx, vals):
+        def body(_, carry):
+            o = scat(idx, vals + carry)
+            return o[0, 0] * 0.0
+        return lax.fori_loop(0, R, body, jnp.float32(0.0))
+
+    out = run(idx, vals)
+    jax.block_until_ready(out)
+    time.sleep(3.0)
+    dt_s = timed_once(lambda: run(idx, vals), lambda o: float(jax.device_get(o)))
+    return {
+        "experiment": f"pscat{'v' if vec_w > 1 else ''} T={tkb}KB N={n_idx} R={R}",
+        "dt_s": round(dt_s, 4),
+        "Mscat_per_s": round(n_idx * R / dt_s / 1e6, 1),
+    }
+
+
+def exp_pscat(tkb: int, n_idx: int, R: int):
+    return _pscat_common(tkb, n_idx, R, 1)
+
+
+def exp_pscatv(tkb: int, n_idx: int, R: int):
+    return _pscat_common(tkb, n_idx, R, 8)
+
+
+def exp_densepath(scale: int):
+    """End-to-end dense A^2: COO->dense (bf16) -> matmul (f32 accum) ->
+    nnz count. R-MAT graph at SCALE; one launch, timed."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from combblas_tpu.utils.rmat import rmat_symmetric_coo_host
+
+    n = 1 << scale
+    rows, cols = rmat_symmetric_coo_host(42, scale, 8)
+    key = rows * np.int64(n) + cols
+    uniq = np.unique(key)
+    rows_u = jnp.asarray((uniq // n).astype(np.int32))
+    cols_u = jnp.asarray((uniq % n).astype(np.int32))
+    nnz = len(uniq)
+    # true flop count: for C = A@A, each entry (i,k) contributes deg_row(k)
+    rdeg = np.bincount((uniq // n).astype(np.int64), minlength=n)
+    flops = float(np.sum(rdeg[(uniq % n).astype(np.int64)]))
+
+    @jax.jit
+    def run(r, c):
+        d = jnp.zeros((n, n), jnp.bfloat16)
+        d = d.at[r, c].set(jnp.bfloat16(1.0), mode="drop")
+        c2 = jnp.dot(d, d, preferred_element_type=jnp.float32)
+        return jnp.sum((c2 != 0).astype(jnp.int32)), c2[0, 0]
+
+    out = run(rows_u, cols_u)
+    jax.block_until_ready(out)
+    time.sleep(3.0)
+    dt_s = timed_once(lambda: run(rows_u, cols_u),
+                      lambda o: int(jax.device_get(o[0])))
+    return {
+        "experiment": f"densepath scale={scale}",
+        "n": n, "nnz": int(nnz),
+        "flops_M": round(flops / 1e6, 2),
+        "dt_s": round(dt_s, 4),
+        "MFLOPs": round(flops / dt_s / 1e6, 2),
+    }
+
+
+def exp_mxu_i8(N: int, R: int):
+    """int8 x int8 -> int32 matmul rate (exact for 0/1 adjacency inputs
+    with counts < 2^31 — the Graph500/TC dense-squaring mode)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    a = jax.device_put(jnp.ones((N, N), jnp.int8))
+
+    @jax.jit
+    def run(a):
+        def body(_, carry):
+            c = jnp.dot(a, carry, preferred_element_type=jnp.int32)
+            return (c & 1).astype(jnp.int8)  # cheap re-binarization
+        return lax.fori_loop(0, R, body, a)
+
+    out = run(a)
+    jax.block_until_ready(out)
+    time.sleep(3.0)
+    dt_s = timed_once(lambda: run(a), lambda o: int(jax.device_get(o[0, 0])))
+    flops = 2.0 * N * N * N * R
+    return {
+        "experiment": f"mxu_i8 N={N} R={R}",
+        "dt_s": round(dt_s, 4),
+        "TOPs": round(flops / dt_s / 1e12, 2),
+    }
+
+
+def exp_mxu_large(dt: str, N: int, R: int):
+    """Matmul rate at large N with NO per-iteration cast traffic: chain
+    C = A@C' where C' stays in the compute dtype (values decay but the
+    timing is what matters)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    dtype = {"bf16": jnp.bfloat16, "f32": jnp.float32}[dt]
+    a = jax.device_put(jnp.full((N, N), 1e-3, dtype))
+
+    @jax.jit
+    def run(a):
+        def body(_, carry):
+            return jnp.dot(a, carry, preferred_element_type=dtype)
+        return lax.fori_loop(0, R, body, a)
+
+    out = run(a)
+    jax.block_until_ready(out)
+    time.sleep(3.0)
+    dt_s = timed_once(lambda: run(a), lambda o: float(jax.device_get(o[0, 0])))
+    flops = 2.0 * N * N * N * R
+    return {
+        "experiment": f"mxu_large {dt} N={N} R={R}",
+        "dt_s": round(dt_s, 4),
+        "TFLOPs": round(flops / dt_s / 1e12, 2),
+    }
+
+
+def exp_psort(t_log2: int, R: int):
+    """Pallas bitonic tile sort: T=2^t_log2 uint32 keys + f32 payload,
+    sorted entirely in VMEM via XOR-partner roll+select stages. The
+    candidate replacement for XLA's 19-38 Mkeys/s sort."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    T = 1 << t_log2
+    RW = T // 128
+
+    def partner(x, j):
+        if j >= 128:
+            m = j // 128
+            n0 = x.shape[0]
+            down = pltpu.roll(x, n0 - m, 0)
+            up = pltpu.roll(x, m, 0)
+            rr = jax.lax.broadcasted_iota(jnp.int32, x.shape, 0)
+            return jnp.where((rr & m) == 0, down, up)
+        down = pltpu.roll(x, 128 - j, 1)
+        up = pltpu.roll(x, j, 1)
+        cc = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+        return jnp.where((cc & j) == 0, down, up)
+
+    def sort_kernel(k_ref, v_ref, ko_ref, vo_ref):
+        keys = k_ref[...]
+        vals = v_ref[...]
+        rr = jax.lax.broadcasted_iota(jnp.int32, keys.shape, 0)
+        cc = jax.lax.broadcasted_iota(jnp.int32, keys.shape, 1)
+        idx = rr * 128 + cc
+        kk = 2
+        while kk <= T:
+            j = kk // 2
+            while j >= 1:
+                pk = partner(keys, j)
+                pv = partner(vals, j)
+                asc = (idx & kk) == 0
+                i_lower = (idx & j) == 0
+                take_self = jnp.where(asc == i_lower, keys <= pk, keys >= pk)
+                keys = jnp.where(take_self, keys, pk)
+                vals = jnp.where(take_self, vals, pv)
+                j //= 2
+            kk *= 2
+        ko_ref[...] = keys
+        vo_ref[...] = vals
+
+    rng = np.random.default_rng(0)
+    keys = jax.device_put(jnp.asarray(
+        rng.integers(0, 1 << 30, size=T).astype(np.uint32).reshape(RW, 128)))
+    vals = jax.device_put(jnp.asarray(
+        rng.random(T).astype(np.float32).reshape(RW, 128)))
+
+    def psort(k, v):
+        return pl.pallas_call(
+            sort_kernel,
+            out_shape=(jax.ShapeDtypeStruct((RW, 128), jnp.uint32),
+                       jax.ShapeDtypeStruct((RW, 128), jnp.float32)),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * 2,
+            out_specs=(pl.BlockSpec(memory_space=pltpu.VMEM),) * 2,
+        )(k, v)
+
+    @jax.jit
+    def run(k, v):
+        def body(_, carry):
+            ks, vs = psort(carry[0], carry[1])
+            # re-shuffle cheaply so the next sort isn't on sorted input
+            return (ks[::-1, :], vs)
+        return lax.fori_loop(0, R, body, (k, v))
+
+    out = run(keys, vals)
+    jax.block_until_ready(out)
+    time.sleep(3.0)
+    dt_s = timed_once(lambda: run(keys, vals),
+                      lambda o: int(jax.device_get(o[0][0, 0])))
+    return {
+        "experiment": f"psort T=2^{t_log2} R={R}",
+        "dt_s": round(dt_s, 4),
+        "Mkeys_per_s": round(T * R / dt_s / 1e6, 1),
+    }
+
+
+def exp_psparsify(m: int, ncol: int, density_pct: int, ph: int, R: int):
+    """Chip rate of the Pallas butterfly-pack sparsify (ops/pallas_sparsify)
+    on a synthetic [m, ncol] f32 matrix at the given % density."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from combblas_tpu.ops.pallas_sparsify import dense_to_tuples_arrays
+
+    rng = np.random.default_rng(0)
+    x_h = np.where(
+        rng.random((m, ncol)) < density_pct / 100.0,
+        rng.random((m, ncol)).astype(np.float32) + 0.5, 0.0
+    ).astype(np.float32)
+    nnz = int((x_h != 0).sum())
+    cap = 1 << int(np.ceil(np.log2(max(nnz, 2) * 1.05)))
+    x = jax.device_put(jnp.asarray(x_h))
+
+    @jax.jit
+    def run(x):
+        def body(_, carry):
+            fi, fv, total, end_row = dense_to_tuples_arrays(
+                carry, capacity=cap, panel_rows=ph)
+            return carry + (total.astype(jnp.float32) * 0.0)
+        return lax.fori_loop(0, R, body, x)
+
+    out = run(x)
+    jax.block_until_ready(out)
+    time.sleep(3.0)
+    dt_s = timed_once(lambda: run(x), lambda o: float(jax.device_get(o[0, 0])))
+    # correctness spot check AFTER timing (poisons, fine)
+    fi, fv, total, end_row = jax.jit(
+        lambda x: dense_to_tuples_arrays(x, capacity=cap, panel_rows=ph)
+    )(x)
+    ok = int(jax.device_get(total)) == nnz
+    return {
+        "experiment": f"psparsify {m}x{ncol} d={density_pct}% ph={ph} R={R}",
+        "nnz": nnz,
+        "dt_s": round(dt_s, 4),
+        "Mcells_per_s": round(m * ncol * R / dt_s / 1e6, 1),
+        "Mnnz_per_s": round(nnz * R / dt_s / 1e6, 1),
+        "total_ok": ok,
+    }
+
+
+def _pallas_op_chain(opname: str, nops: int, R: int, rows: int = 8192):
+    """Sustained rate of a chained vector op inside ONE Pallas kernel on a
+    VMEM-resident [rows, 128] f32 array. Classifies which Mosaic ops hit
+    the ~2.5-7 G elem-op/s wall seen in the butterfly-pack kernel."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    def kernel(x_ref, o_ref):
+        x = x_ref[...]
+        acc = x
+        cc = lax.broadcasted_iota(jnp.int32, x.shape, 1)
+        for i in range(nops):
+            if opname == "add":
+                acc = acc + x
+            elif opname == "select":
+                acc = jnp.where((cc & (1 << (i % 7))) != 0, acc, x)
+            elif opname == "roll0":
+                acc = pltpu.roll(acc, (7 * i + 1) % rows, 0)
+            elif opname == "roll1":
+                acc = pltpu.roll(acc, (7 * i + 1) % 128, 1)
+            elif opname == "roll0_8":
+                acc = pltpu.roll(acc, 8 * ((7 * i) % (rows // 8)) + 8, 0)
+            elif opname == "mxushift":
+                # lane shift as matmul with a shifted identity
+                sh = (jnp.eye(128, k=1, dtype=jnp.bfloat16)
+                      if i % 2 == 0 else jnp.eye(128, k=-1, dtype=jnp.bfloat16))
+                acc = jnp.dot(acc.astype(jnp.bfloat16), sh,
+                              preferred_element_type=jnp.float32)
+            else:
+                raise ValueError(opname)
+        o_ref[...] = acc
+
+    def run_once(x):
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((rows, 128), jnp.float32),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+            compiler_params=pltpu.CompilerParams(
+                vmem_limit_bytes=100 * 1024 * 1024,
+            ),
+        )(x)
+
+    x = jax.device_put(jnp.ones((rows, 128), jnp.float32))
+
+    @jax.jit
+    def run(x):
+        def body(_, carry):
+            return run_once(carry) * 0.5
+        return lax.fori_loop(0, R, body, x)
+
+    out = run(x)
+    jax.block_until_ready(out)
+    time.sleep(3.0)
+    dt_s = timed_once(lambda: run(x), lambda o: float(jax.device_get(o[0, 0])))
+    return {
+        "experiment": f"pop {opname} nops={nops} rows={rows} R={R}",
+        "dt_s": round(dt_s, 4),
+        "Gelem_op_per_s": round(rows * 128 * nops * R / dt_s / 1e9, 2),
+    }
+
+
+def exp_densespgemm(scale: int, sparsifier: str = "windowed"):
+    """End-to-end dense A^2 WITH extraction: COO->bf16 dense -> MXU matmul
+    (f32 accum) -> sparse tuples via the chosen extractor ("windowed" =
+    ops.spgemm.sparsify_windowed; "pallas" = butterfly-pack; "none").
+    One launch, timed; correctness checked after timing vs scipy."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from scipy import sparse
+
+    from combblas_tpu.ops.spgemm import sparsify_windowed
+    from combblas_tpu.utils.rmat import rmat_symmetric_coo_host
+
+    n = 1 << scale
+    rows, cols = rmat_symmetric_coo_host(5, scale, 8)
+    key = rows * np.int64(n) + cols
+    uniq = np.unique(key)
+    ru = jnp.asarray((uniq // n).astype(np.int32))
+    cu = jnp.asarray((uniq % n).astype(np.int32))
+    S = sparse.csr_matrix(
+        (np.ones(len(uniq), np.float32), ((uniq // n), (uniq % n))),
+        shape=(n, n))
+    C_ref = S @ S
+    nnz_out = int(C_ref.nnz)
+    rdeg = np.bincount((uniq // n).astype(np.int64), minlength=n)
+    flops = float(np.sum(rdeg[(uniq % n).astype(np.int64)]))
+    cap = 1 << int(np.ceil(np.log2(nnz_out * 1.05)))
+
+    @jax.jit
+    def run(r, c):
+        d = jnp.zeros((n, n), jnp.bfloat16)
+        d = d.at[r, c].set(jnp.bfloat16(1.0), mode="drop")
+        c2 = jnp.dot(d, d, preferred_element_type=jnp.float32)
+        if sparsifier == "windowed":
+            t, total = sparsify_windowed(c2, 0.0, n, n, cap)
+            return t.rows, t.cols, t.vals, total
+        elif sparsifier == "pallas":
+            from combblas_tpu.ops.pallas_sparsify import dense_to_sptuples
+            t, total = dense_to_sptuples(c2, n, n, capacity=cap)
+            return t.rows, t.cols, t.vals, total
+        else:
+            return r, c, jnp.sum(c2), jnp.sum((c2 != 0).astype(jnp.int32))
+
+    out = run(ru, cu)
+    jax.block_until_ready(out)
+    time.sleep(5.0)
+    dt_s = timed_once(lambda: run(ru, cu),
+                      lambda o: int(jax.device_get(o[3])))
+    res = {
+        "experiment": f"densespgemm scale={scale} sparsifier={sparsifier}",
+        "flops_M": round(flops / 1e6, 2),
+        "out_nnz": nnz_out,
+        "got_nnz": int(jax.device_get(out[3])),
+        "dt_s": round(dt_s, 4),
+        "MFLOPs": round(flops / dt_s / 1e6, 2),
+    }
+    if sparsifier != "none":
+        rr = np.asarray(jax.device_get(out[0]))
+        cc = np.asarray(jax.device_get(out[1]))
+        vv = np.asarray(jax.device_get(out[2]))
+        live = rr < n
+        vsum = float(vv[live].sum())
+        res["live_nnz_ok"] = bool(int(live.sum()) == nnz_out)
+        res["vsum_ok"] = bool(
+            abs(vsum - float(C_ref.sum())) < 1e-2 * float(C_ref.sum()))
+    return res
+
+
+def exp_cumsum2d(m: int, ncol: int, R: int):
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    x = jax.device_put(jnp.ones((m, ncol), jnp.float32))
+
+    @jax.jit
+    def run(x):
+        def body(_, carry):
+            c = jnp.cumsum(carry, axis=1)
+            return c * (1.0 / ncol)
+        return lax.fori_loop(0, R, body, x)
+
+    out = run(x)
+    jax.block_until_ready(out)
+    time.sleep(3.0)
+    dt_s = timed_once(lambda: run(x), lambda o: float(jax.device_get(o[0, 0])))
+    return {
+        "experiment": f"cumsum2d {m}x{ncol} R={R}",
+        "dt_s": round(dt_s, 4),
+        "Melem_per_s": round(m * ncol * R / dt_s / 1e6, 1),
+    }
+
+
+def exp_topk(m: int, ncol: int, k: int, R: int):
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    x = jax.device_put(jnp.arange(m * ncol, dtype=jnp.float32).reshape(m, ncol) % 997.0)
+
+    @jax.jit
+    def run(x):
+        def body(_, carry):
+            v, _i = lax.top_k(carry, k)
+            return carry.at[:, :k].set(v * 1e-6)
+        return lax.fori_loop(0, R, body, x)
+
+    out = run(x)
+    jax.block_until_ready(out)
+    time.sleep(3.0)
+    dt_s = timed_once(lambda: run(x), lambda o: float(jax.device_get(o[0, 0])))
+    return {
+        "experiment": f"topk {m}x{ncol} k={k} R={R}",
+        "dt_s": round(dt_s, 4),
+        "Melem_per_s": round(m * ncol * R / dt_s / 1e6, 1),
+    }
+
+
+def main():
+    exp = sys.argv[1]
+    a = sys.argv[2:]
+    if exp == "mxu":
+        out = exp_mxu(a[0], int(a[1]), int(a[2]))
+    elif exp == "mxu3":
+        out = exp_mxu3(int(a[0]), int(a[1]))
+    elif exp == "pdma":
+        out = exp_pdma(int(a[0]), int(a[1]))
+    elif exp == "pscat":
+        out = exp_pscat(int(a[0]), int(a[1]), int(a[2]))
+    elif exp == "pscatv":
+        out = exp_pscatv(int(a[0]), int(a[1]), int(a[2]))
+    elif exp == "densepath":
+        out = exp_densepath(int(a[0]))
+    elif exp == "mxu_i8":
+        out = exp_mxu_i8(int(a[0]), int(a[1]))
+    elif exp == "mxu_large":
+        out = exp_mxu_large(a[0], int(a[1]), int(a[2]))
+    elif exp == "psort":
+        out = exp_psort(int(a[0]), int(a[1]))
+    elif exp == "psparsify":
+        out = exp_psparsify(int(a[0]), int(a[1]), int(a[2]), int(a[3]), int(a[4]))
+    elif exp == "densespgemm":
+        out = exp_densespgemm(int(a[0]), a[1] if len(a) > 1 else "windowed")
+    elif exp == "pop":
+        out = _pallas_op_chain(a[0], int(a[1]), int(a[2]))
+    elif exp == "cumsum2d":
+        out = exp_cumsum2d(int(a[0]), int(a[1]), int(a[2]))
+    elif exp == "topk":
+        out = exp_topk(int(a[0]), int(a[1]), int(a[2]), int(a[3]))
+    else:
+        raise SystemExit(f"unknown experiment {exp}")
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

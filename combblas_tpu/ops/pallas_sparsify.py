@@ -1,0 +1,316 @@
+"""Pallas dense→sparse compaction — the round-4 "break the 22 M/s wall" kernel.
+
+THE PROBLEM.  Every per-element random memory op on the target chip runs at
+~21-27 M/s (gather, scatter, segment-sum — measured, PERF_NOTES_r3.md), so
+any XLA formulation of "extract the nonzeros of a dense matrix" pays ≥1-2
+output-sized random passes: ~1-2 s for a 20M-nonzero extraction.  That tax
+is what capped the round-2 dense-block SpGEMM at 2.9 MFLOP/s (36 s, almost
+all in ``sparsify``'s binary searches) and what VERDICT r3 item 1 demands a
+Pallas answer to.  The reference gets the same job done with cache-resident
+hash accumulation (``mtSpGEMM.h:214-440``); the TPU has no scatter unit at
+all — Mosaic rejects even scalar stores to VMEM ("Cannot store scalars to
+VMEM", benchmarks/results/probe_r4b.txt) — so the fix cannot be "scatter,
+but in VMEM".  Contiguity has to be MANUFACTURED with vector primitives.
+
+THE KERNEL.  Compaction is a MONOTONE ROUTING problem, and monotone routes
+run conflict-free through a butterfly: element j with rank r_j (exclusive
+prefix-count of preceding nonzeros) must move LEFT by d_j = j - r_j, and
+since d_j is non-decreasing along j, applying the binary decomposition of
+d_j one bit per stage (shift-by-2^s where bit s of d is set) never lands
+two elements on one slot.  (Proof: after stage s every survivor sits at
+r_j + 2^(s+1) * floor(d_j / 2^(s+1)); for j1 < j2 both terms are ordered —
+r strictly increases, floor is non-decreasing — so positions stay
+distinct.)  Each stage is a few ``pltpu.roll``s and selects per carried
+array — pure VPU work on VMEM-resident vregs, NO random memory ops.
+
+The matrix streams through the kernel as the FLAT row-major [M*N/128, 128]
+view (a free XLA reshape — row-major bitcast), in panels of
+``_PANEL_ROWS`` x 128 elements:
+
+  rank:   lane-axis log-shift prefix sums + a sublane-offset cascade
+  route:  log2(panel) butterfly stages of roll+select
+  write:  ONE sequential 8-row-aligned DMA per panel at a running offset
+          (SMEM carry), sized from a static row-bucket ladder; bucket
+          slack is sentinel-filled, inter-panel gaps are < 1024 elements
+          and read as padding (SpTuples tolerates non-prefix padding)
+
+Panels walk the flat stream in order, so the packed output is EXACTLY the
+row-major nonzero stream — a sorted, (almost-)compacted SpTuples with no
+further sort.
+
+Throughput model: ~21 stages x ~10 vector ops per panel ≈ 250 VMEM passes
+at VPU rates ≈ tens of ms for a 1 GB matrix — versus ~2 s for the XLA
+scatter path and ~26-36 s for the round-2 searchsorted path.
+
+Reference counterpart: the dense→sparse leg of ``SpTuples`` construction /
+``Dcsc`` build; the performance role matches the in-cache accumulator of
+``mtSpGEMM.h`` (what lets SpGEMM emit sparse output at memory speed).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+Array = jax.Array
+
+#: Flat-view panel height (x128 lanes = 1M elements per panel).
+_PANEL_ROWS = 8192
+
+#: Row-count ladder for the per-panel output DMA: the smallest bucket
+#: >= ceil(count/128) rows is written (bucket slack is sentinel-filled).
+#: Multiples of 8 — Mosaic requires dim-0 slices aligned to the (8, 128)
+#: tile, and the running output offset stays 8-aligned the same way.
+_ROW_BUCKETS = (8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096)
+
+
+def _leftshift(x: Array, t: int) -> Array:
+    """Flat left-shift by t over the row-major [R, 128] layout:
+    out[r, c] = x_flat[r*128 + c + t] (cyclic junk at the very end)."""
+    R = x.shape[0]
+    sub, lane = divmod(t, 128)
+    if lane == 0:
+        return pltpu.roll(x, (R - sub) % max(R, 1), 0)
+    y = pltpu.roll(x, 128 - lane, 1)  # y[r, c] = x[r, (c + lane) % 128]
+    ynext = pltpu.roll(y, (R - sub - 1) % R, 0)
+    ycur = pltpu.roll(y, (R - sub) % R, 0) if sub else y
+    cc = lax.broadcasted_iota(jnp.int32, x.shape, 1)
+    return jnp.where(cc < 128 - lane, ycur, ynext)
+
+
+def _prefix_ranks(mask_i32: Array) -> Array:
+    """Exclusive prefix-count of ``mask_i32 [R, 128]`` in row-major flat
+    order: log2(128) lane shift-adds + log2(R) sublane shift-adds."""
+    R = mask_i32.shape[0]
+    acc = mask_i32
+    t = 1
+    while t < 128:
+        sh = pltpu.roll(acc, t, 1)  # sh[r, c] = acc[r, c - t]
+        cc = lax.broadcasted_iota(jnp.int32, acc.shape, 1)
+        acc = acc + jnp.where(cc >= t, sh, 0)
+        t *= 2
+    row_tot = acc[:, 127:]  # [R, 1] inclusive row totals
+    rowoff = row_tot
+    t = 1
+    while t < R:
+        sh = pltpu.roll(rowoff, t, 0)
+        rr = lax.broadcasted_iota(jnp.int32, rowoff.shape, 0)
+        rowoff = rowoff + jnp.where(rr >= t, sh, 0)
+        t *= 2
+    rowoff = rowoff - row_tot  # exclusive row offsets
+    return acc - mask_i32 + rowoff  # exclusive flat rank
+
+
+def _pack_kernel(
+    x_ref, idx_out_ref, val_out_ref, counts_ref, wrote_ref, off_sm,
+    scratch_i, scratch_v, dma_sem, *, zero: float, pr: int, cap_rows: int,
+):
+    p = pl.program_id(0)
+
+    @pl.when(p == 0)
+    def _():
+        off_sm[0] = 0
+
+    x = x_ref[...]  # [pr, 128] flat panel
+    mask = (x != zero).astype(jnp.int32)
+    rank = _prefix_ranks(mask)
+    total = jnp.sum(mask)
+    rr = lax.broadcasted_iota(jnp.int32, x.shape, 0)
+    cc = lax.broadcasted_iota(jnp.int32, x.shape, 1)
+    flat = rr * 128 + cc
+    # displacement; invalid slots carry d = -1 (doubles as routed validity)
+    d = jnp.where(mask == 1, flat - rank, -1)
+    vals = x
+    idx = flat + p * (pr * 128)  # global flat index
+    s = 1
+    while s < pr * 128:
+        d_in = _leftshift(d, s)
+        take_in = (d_in >= 0) & ((d_in & s) != 0)
+        keep = (d >= 0) & ((d & s) == 0)
+        vals = jnp.where(take_in, _leftshift(vals, s), vals)
+        idx = jnp.where(take_in, _leftshift(idx, s), idx)
+        d = jnp.where(take_in, d_in - s, jnp.where(keep, d, -1))
+        s *= 2
+    # packed prefix + sentinel tail (bucket slack reads as padding)
+    live = flat < total
+    scratch_i[...] = jnp.where(live, idx, -1)
+    scratch_v[...] = jnp.where(live, vals, jnp.asarray(zero, x.dtype))
+    off = off_sm[0]
+    rows_used8 = lax.div(total + (8 * 128 - 1), 8 * 128) * 8  # 8-aligned
+
+    # smallest ladder bucket >= rows_used8, computed arithmetically so the
+    # "did this panel get written" flag is exact (overflow never exposes
+    # unwritten output rows as live)
+    ladder = [b for b in _ROW_BUCKETS if b < pr] + [pr]
+    chosen = jnp.int32(ladder[-1])
+    for b in reversed(ladder):
+        chosen = jnp.where(rows_used8 <= b, jnp.int32(b), chosen)
+    fired = (total > 0) & (off + chosen <= cap_rows)
+    for b in ladder:
+
+        @pl.when(fired & (chosen == b))
+        def _(b=b):
+            aligned_off = pl.multiple_of(off, 8)
+            cp1 = pltpu.make_async_copy(
+                scratch_i.at[pl.ds(0, b), :],
+                idx_out_ref.at[pl.ds(aligned_off, b), :],
+                dma_sem.at[0],
+            )
+            cp2 = pltpu.make_async_copy(
+                scratch_v.at[pl.ds(0, b), :],
+                val_out_ref.at[pl.ds(aligned_off, b), :],
+                dma_sem.at[1],
+            )
+            cp1.start()
+            cp2.start()
+            cp1.wait()
+            cp2.wait()
+
+    counts_ref[p] = total
+    wrote_ref[p] = jnp.where(fired, rows_used8, 0)
+    off_sm[0] = off + jnp.where(fired, rows_used8, 0)
+
+
+def flat_to_tuples_arrays(
+    xf: Array,
+    *,
+    zero: float = 0.0,
+    capacity: int,
+    panel_rows: int = _PANEL_ROWS,
+    interpret: bool = False,
+) -> tuple[Array, Array, Array, Array]:
+    """Compact the nonzeros (!= ``zero``) of the flat row-major view
+    ``xf [R, 128]``.
+
+    Returns (flat_idx int32 [cap], vals [cap], total int32, end_row int32):
+    ``flat_idx`` holds global flat indices, ``-1`` on padding slots; valid
+    slots are exactly ``(flat_idx >= 0) & (slot < end_row*128)``.
+    ``total`` is the exact nonzero count even when it exceeds ``capacity``
+    (the overflow-detection contract; overflowing panels are dropped
+    whole).  R must divide by ``panel_rows`` (a multiple of 8).
+    """
+    import math
+
+    R, L = xf.shape
+    assert L == 128, xf.shape
+    pr = math.gcd(R, min(panel_rows, R))  # largest pow2-ish divisor <= cap
+    assert R % pr == 0 and pr % 8 == 0, (R, pr)
+    npanels = R // pr
+    # 8 extra rows per panel so rounding slack can never evict real
+    # entries: total <= capacity implies every panel is written
+    cap_rows = -(-capacity // 128)
+    cap_rows = -(-cap_rows // 8) * 8 + 8 * npanels
+    pad_rows = cap_rows + pr  # one full bucket may overhang past cap_rows
+    kernel = functools.partial(
+        _pack_kernel, zero=zero, pr=pr, cap_rows=cap_rows
+    )
+    idx_out, val_out, counts, wrote = pl.pallas_call(
+        kernel,
+        grid=(npanels,),
+        in_specs=[
+            pl.BlockSpec((pr, 128), lambda p: (p, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((pad_rows, 128), jnp.int32),
+            jax.ShapeDtypeStruct((pad_rows, 128), xf.dtype),
+            jax.ShapeDtypeStruct((npanels,), jnp.int32),
+            jax.ShapeDtypeStruct((npanels,), jnp.int32),
+        ),
+        scratch_shapes=[
+            pltpu.SMEM((1,), jnp.int32),
+            pltpu.VMEM((pr, 128), jnp.int32),
+            pltpu.VMEM((pr, 128), xf.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024,
+        ),
+        interpret=interpret,
+    )(xf)
+    total = jnp.sum(counts)
+    end_row = jnp.sum(wrote)
+    flat_cap = cap_rows * 128
+    return (
+        idx_out.reshape(-1)[:flat_cap],
+        val_out.reshape(-1)[:flat_cap],
+        total,
+        end_row,
+    )
+
+
+def dense_to_tuples_arrays(
+    x: Array,
+    *,
+    zero: float = 0.0,
+    capacity: int,
+    panel_rows: int = _PANEL_ROWS,
+    interpret: bool = False,
+) -> tuple[Array, Array, Array, Array]:
+    """2-D entry: reshape ``x [M, N]`` to the flat [M*N/128, 128] view (a
+    free row-major bitcast in XLA) and pack. See ``flat_to_tuples_arrays``.
+    """
+    M, N = x.shape
+    assert (M * N) % 128 == 0, (M, N)
+    return flat_to_tuples_arrays(
+        x.reshape(-1, 128), zero=zero, capacity=capacity,
+        panel_rows=panel_rows, interpret=interpret,
+    )
+
+
+def dense_to_sptuples(
+    x: Array,
+    nrows: int,
+    ncols: int,
+    *,
+    zero: float = 0.0,
+    capacity: int,
+    panel_rows: int = _PANEL_ROWS,
+    interpret: bool = False,
+):
+    """Dense [M>=nrows, N>=ncols] (padded) → row-major-sorted SpTuples +
+    exact pre-truncation count.
+
+    The Pallas replacement for ``ops.spgemm.sparsify`` (whose per-slot
+    binary searches cost ~0.8 us each on the target chip).  Entries in
+    padding rows/cols (>= nrows/ncols) must already equal ``zero``.  The
+    result's padding is NOT a suffix (8-row-aligned inter-panel gaps hold
+    sentinels) — fine for every masked op; run ``_select`` to canonicalize
+    if a prefix layout is required.
+    """
+    from .tuples import SpTuples
+
+    M, N = x.shape
+    fi, fv, total, end_row = dense_to_tuples_arrays(
+        x, zero=zero, capacity=capacity, panel_rows=panel_rows,
+        interpret=interpret,
+    )
+    cap = fi.shape[0]
+    live = (fi >= 0) & (jnp.arange(cap, dtype=jnp.int32) < end_row * 128)
+    r = fi // N
+    rows = jnp.where(live, r, nrows)
+    cols = jnp.where(live, fi - r * N, ncols)
+    vals = jnp.where(live, fv, 0)
+    nnz = jnp.sum(live.astype(jnp.int32))
+    return (
+        SpTuples(
+            rows=rows.astype(jnp.int32),
+            cols=cols.astype(jnp.int32),
+            vals=vals,
+            nnz=nnz,
+            nrows=nrows,
+            ncols=ncols,
+        ),
+        total,
+    )

@@ -189,6 +189,91 @@ def densify(t: SpTuples, pad_rows: int, pad_cols: int, zero) -> Array:
     return dense.reshape(pad_rows, pad_cols)
 
 
+def sparsify_windowed(
+    dense: Array, zero, nrows: int, ncols: int, capacity: int
+) -> tuple[SpTuples, Array]:
+    """Dense [R, C] → compacted row-major SpTuples, output-driven with
+    CONTIGUOUS-WINDOW narrowing (round 4).
+
+    The target chip prices every per-element RANDOM memory op at ~22 M/s
+    but serves one-index CONTIGUOUS multi-lane windows at ~130 M/s
+    (PERF_NOTES_r3 cost model), and streams elementwise passes at only
+    ~1 G elem-op/s (probe_r4e) — so an extraction must (a) be output-
+    driven (input-driven scatters pay per CELL, and the r2 binary-search
+    sparsify paid ~14 random probes per slot), and (b) spend its few
+    per-slot memory ops on windows, not point gathers.  Scheme:
+
+      counts:  8-cell group counts + 128-cell chunk prefix tables (MXU /
+               streaming passes over the dense input — no random ops)
+      slots:   ``expand_ranges`` over the 2M chunk counts → each output
+               slot learns its (chunk, rank-within-chunk) for one
+               chunk-sized scatter + one output-sized cummax
+      narrow:  TWO window gathers per slot — the chunk's 16-entry group-
+               prefix window (locates the 8-cell group) and the group's 8
+               values (locates the lane IN REGISTER: the winning lane is
+               selected by comparing the group's running nonzero count to
+               the residual rank — no take_along_axis anywhere)
+
+    Exact, sorted row-major, ~2 window ops + ~40 lanes of vector work per
+    output slot.  The Pallas butterfly-pack alternative
+    (``ops/pallas_sparsify``) is bound by the same chip's ~1 G elem-op/s
+    vector wall across its ~100+ routing passes and measures 4-10x slower
+    at bench densities; it remains available for the high-density regime
+    and as the documented routing-network experiment.
+    """
+    from .segment import expand_ranges
+
+    R, C = dense.shape
+    flat = dense.reshape(-1)
+    ncell = R * C
+    assert ncell % 128 == 0, (R, C)
+    nch = ncell // 128
+    mask = dense != zero
+    if C != ncols:
+        mask = mask & (jnp.arange(C, dtype=jnp.int32)[None, :] < ncols)
+    if R != nrows:
+        mask = mask & (jnp.arange(R, dtype=jnp.int32)[:, None] < nrows)
+    m3 = mask.reshape(nch, 16, 8)
+    t8 = jnp.sum(m3, axis=2, dtype=jnp.int32)  # [nch, 16] group counts
+    g8 = jnp.cumsum(t8, axis=1) - t8  # exclusive group prefix within chunk
+    tch = jnp.sum(t8, axis=1)  # [nch] chunk counts
+    owner, t, valid, total = expand_ranges(tch, capacity)
+    owner = jnp.minimum(owner, nch - 1)
+    # level 1: 16-lane window of the chunk's group prefix
+    w16 = g8.reshape(-1)[owner[:, None] * 16
+                         + jnp.arange(16, dtype=jnp.int32)[None, :]]
+    le = w16 <= t[:, None]
+    b = jnp.sum(le, axis=1).astype(jnp.int32) - 1  # group index
+    r8 = t - jnp.max(jnp.where(le, w16, 0), axis=1)  # rank within group
+    # level 2: the group's 8 cells (values + mask) in one window each
+    gbase = (owner * 16 + b) * 8
+    w8 = flat[gbase[:, None] + jnp.arange(8, dtype=jnp.int32)[None, :]]
+    m8 = w8 != zero
+    if C != ncols or R != nrows:
+        cell = gbase[:, None] + jnp.arange(8, dtype=jnp.int32)[None, :]
+        if C != ncols:
+            m8 = m8 & (cell % C < ncols)
+        if R != nrows:
+            m8 = m8 & (cell // C < nrows)
+    excl8 = jnp.cumsum(m8.astype(jnp.int32), axis=1) - m8.astype(jnp.int32)
+    sel = m8 & (excl8 == r8[:, None])  # exactly one lane per valid slot
+    lane = jnp.sum(jnp.where(sel, jnp.arange(8, dtype=jnp.int32)[None, :], 0),
+                   axis=1)
+    vals = jnp.sum(jnp.where(sel, w8, 0), axis=1)
+    fi = gbase + lane
+    rows = jnp.where(valid, fi // C, nrows).astype(jnp.int32)
+    cols = jnp.where(valid, fi % C, ncols).astype(jnp.int32)
+    vals = jnp.where(valid, vals, 0)
+    return (
+        SpTuples(
+            rows=rows, cols=cols, vals=vals,
+            nnz=jnp.minimum(total, capacity).astype(jnp.int32),
+            nrows=nrows, ncols=ncols,
+        ),
+        total,
+    )
+
+
 def sparsify(
     dense: Array, zero, nrows: int, ncols: int, capacity: int
 ) -> tuple[SpTuples, Array]:

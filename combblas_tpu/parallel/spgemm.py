@@ -686,9 +686,40 @@ _PALLAS_KINDS = {
 }
 
 
+def _mxu_dot(da, db, mode: str, out_dtype):
+    """Dense plus_times stage product at the requested precision.
+
+    Measured on the target chip (benchmarks/results/probe_r4a/b):
+      f32 native dot      ~0.11 TFLOP/s  (exact f32)
+      bf16 inputs         ~13.3 TFLOP/s  (EXACT when inputs are bf16-
+                          representable — e.g. 0/1 adjacency — and the
+                          f32-accumulated counts stay < 2^24)
+      bf16x3 split-float  ~2-4 TFLOP/s   (hi/lo decomposition, error
+                          ~2^-16 per operand — f32-grade for graph work)
+    """
+    if mode == "f32":
+        return jnp.dot(da, db, preferred_element_type=out_dtype)
+    if mode == "bf16":
+        return jnp.dot(
+            da.astype(jnp.bfloat16), db.astype(jnp.bfloat16),
+            preferred_element_type=jnp.float32,
+        ).astype(out_dtype)
+    assert mode == "bf16x3", mode
+    ah = da.astype(jnp.bfloat16)
+    al = (da - ah.astype(da.dtype)).astype(jnp.bfloat16)
+    bh = db.astype(jnp.bfloat16)
+    bl = (db - bh.astype(db.dtype)).astype(jnp.bfloat16)
+    out = (
+        jnp.dot(ah, bh, preferred_element_type=jnp.float32)
+        + jnp.dot(ah, bl, preferred_element_type=jnp.float32)
+        + jnp.dot(al, bh, preferred_element_type=jnp.float32)
+    )
+    return out.astype(out_dtype)
+
+
 @partial(
     jax.jit,
-    static_argnames=("sr", "out_capacity", "interpret"),
+    static_argnames=("sr", "out_capacity", "mode", "interpret"),
 )
 def summa_spgemm_mxu(
     sr: Semiring,
@@ -696,27 +727,31 @@ def summa_spgemm_mxu(
     B: SpParMat,
     *,
     out_capacity: int,
+    mode: str = "f32",
     interpret: bool = False,
 ) -> tuple[SpParMat, jax.Array]:
     """Dense-block SUMMA: stage products run on the MATRIX UNIT.
 
-    On this TPU, XLA's sort tops out near 19-38 Mkeys/s (measured,
-    benchmarks/results/microbench_r2b.txt), capping the ESC kernel at a
-    few MFLOP/s — while the MXU delivers tens of TFLOP/s on dense blocks.
-    Below ~32K tile dims, spending n³ dense FLOPs beats sorting the sparse
-    expansion by orders of magnitude: stage tiles densify (sorted-scatter),
-    multiply via the Pallas semiring matmul (``ops/pallas_kernels`` — MXU
-    dot for plus_times, VPU chunked fold for min_plus/max_min), accumulate
-    into a DENSE [lr, lcB] buffer, and sparsify ONCE at the end (sort-free
-    cumsum + binary search). This is the "dense-block strategy for heavy
-    columns" SURVEY §7 hard-part (b) called for, taken to whole tiles.
+    On this TPU every sparse-side primitive is capped by the ~22 M/s
+    per-element random-memory wall (PERF_NOTES_r3) while the MXU delivers
+    13.3 TFLOP/s on bf16 blocks — below ~32K tile dims, spending n³ dense
+    FLOPs beats sorting the sparse expansion outright: stage tiles densify
+    (sorted-scatter), multiply via ``_mxu_dot`` (plus_times; ``mode``
+    picks the precision/speed point) or the Pallas semiring matmul
+    (min_plus/max_min — XLA has no tropical MXU lowering), accumulate into
+    a DENSE [lr, lcB] buffer, and extract ONCE at the end with the
+    windowed output-driven extraction (``ops.spgemm.sparsify_windowed``
+    — ~2 contiguous-window ops per output slot; the round-2 searchsorted
+    extraction cost 26+ s at scale 14 and is gone).  This is the
+    "dense-block strategy for heavy columns" SURVEY §7 hard-part (b),
+    taken to whole tiles.
 
     Returns (C, overflow) like ``summa_spgemm_scan`` (overflow = max tile
     nonzero count minus out_capacity; exact counts even when truncating).
     SUMMA3D layers compose the same way (per-layer tiles are smaller).
     """
     from ..ops.pallas_kernels import semiring_matmul
-    from ..ops.spgemm import densify, sparsify
+    from ..ops.spgemm import densify, sparsify_windowed
 
     _check_compat(A, B)
     kind = _PALLAS_KINDS.get(sr.name)
@@ -741,19 +776,16 @@ def summa_spgemm_mxu(
             da = densify(a_stages[s], pm, pk, zero)
             db = densify(b_stages[s], pk, pn, zero)
             if kind == "plus_times":
-                # XLA's own MXU tiling beats a hand-blocked kernel for the
-                # ring the hardware natively supports (measured 3.7 TFLOP/s
-                # f32 on this chip)
-                prod = jnp.dot(da, db, preferred_element_type=acc.dtype)
+                prod = _mxu_dot(da, db, mode, acc.dtype)
             else:
                 # XLA has no MXU/VPU lowering for tropical rings — this is
-                # where the Pallas kernel earns its keep
+                # where the Pallas dense kernel earns its keep
                 prod = semiring_matmul(
                     kind, da, db, bm=256, bk=512, bn=256,
                     interpret=interpret,
                 )
             acc = sr.add(acc, prod)
-        out, total = sparsify(acc, zero, lrA, lcB, out_capacity)
+        out, total = sparsify_windowed(acc, zero, lrA, lcB, out_capacity)
         worst = jnp.maximum(total - out_capacity, 0)
         worst = lax.pmax(lax.pmax(worst, ROW_AXIS), COL_AXIS)
         return SpParMat._pack_tile(out) + (worst[None, None],)
@@ -785,11 +817,18 @@ def spgemm_auto(
     out_capacity: int | None = None,
     slack: float = 1.1,
     max_retries: int = 3,
+    mode: str = "f32",
     interpret: bool = False,
 ) -> SpParMat:
     """Kernel-selecting SpGEMM: dense-block MXU path when the tiles fit
     and the semiring has a dense kernel; scanned ESC otherwise. Retries
-    with exact sizing on overflow (the estimateNNZ_Hash loop)."""
+    with exact sizing on overflow (the estimateNNZ_Hash loop).
+
+    ``mode`` sets the plus_times dense precision (see ``_mxu_dot``):
+    "f32" (exact, slow MXU path), "bf16" (13.3 TFLOP/s — exact for
+    bf16-representable values like 0/1 adjacency with counts < 2^24),
+    "bf16x3" (split-float, f32-grade error, ~4x faster than f32).
+    """
     fits = (
         max(A.local_rows, A.local_cols, B.local_cols) <= MXU_MAX_TILE_DIM
         and sr.name in _PALLAS_KINDS
@@ -805,7 +844,8 @@ def spgemm_auto(
     over = 0
     for _ in range(max_retries + 1):
         C, overflow = summa_spgemm_mxu(
-            sr, A, B, out_capacity=out_capacity, interpret=interpret
+            sr, A, B, out_capacity=out_capacity, mode=mode,
+            interpret=interpret,
         )
         over = int(overflow)
         if over <= 0:

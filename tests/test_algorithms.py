@@ -335,3 +335,18 @@ def test_sssp_batch_matches_single(rng):
     for w, s in enumerate(srcs):
         dist, _ = sssp(A, s)
         np.testing.assert_allclose(got[:, w], dist.to_global(), rtol=1e-5)
+
+
+def test_triangle_count_dense_kernel(rng):
+    """Round-4 one-launch MXU TC must match the sparse path."""
+    from combblas_tpu.models.tc import triangle_count
+
+    grid = Grid.make(1, 1)
+    n = 40
+    d = (rng.random((n, n)) < 0.25).astype(np.float32)
+    d = np.maximum(d, d.T)
+    np.fill_diagonal(d, 0.0)
+    A = SpParMat.from_dense(grid, d)
+    want = triangle_count(A, kernel="sparse")
+    got = triangle_count(A, kernel="dense")
+    assert got == want

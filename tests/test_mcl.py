@@ -342,3 +342,43 @@ print("OK", it, ch)
     )
     assert r.returncode == 0, r.stderr[-2000:]
     assert "OK" in r.stdout
+
+
+def test_mcl_dense_matches_sparse(rng):
+    """The round-4 dense one-launch loop must produce the same clustering
+    as the sparse path on a 1x1 grid (two cliques + bridge)."""
+    grid = Grid.make(1, 1)
+    n = 16
+    d = np.zeros((n, n), np.float32)
+    d[:8, :8] = 1.0
+    d[8:, 8:] = 1.0
+    np.fill_diagonal(d, 0.0)
+    d[7, 8] = d[8, 7] = 0.05
+    A = SpParMat.from_dense(grid, d)
+    lab_s, _, _ = mcl(A, inflation=2.0)
+    lab_d, it_d, ch_d = mcl(A, inflation=2.0, expansion="dense")
+    g1, g2 = lab_s.to_global(), lab_d.to_global()
+    assert (g1[:, None] == g1[None, :]).tolist() == (
+        (g2[:, None] == g2[None, :]).tolist()
+    )
+    assert ch_d < 1e-3 and it_d >= 1
+
+
+def test_mcl_dense_random_partition(rng):
+    """Dense vs sparse on a random block-structured graph (three groups)."""
+    grid = Grid.make(1, 1)
+    n = 24
+    d = np.zeros((n, n), np.float32)
+    for lo, hi in [(0, 8), (8, 16), (16, 24)]:
+        blk = (rng.random((hi - lo, hi - lo)) < 0.8).astype(np.float32)
+        d[lo:hi, lo:hi] = np.maximum(blk, blk.T)
+    np.fill_diagonal(d, 0.0)
+    d[7, 8] = d[8, 7] = 0.05
+    d[15, 16] = d[16, 15] = 0.05
+    A = SpParMat.from_dense(grid, d)
+    lab_s, _, _ = mcl(A, inflation=2.0)
+    lab_d, _, _ = mcl(A, inflation=2.0, expansion="dense")
+    g1, g2 = lab_s.to_global(), lab_d.to_global()
+    assert (g1[:, None] == g1[None, :]).tolist() == (
+        (g2[:, None] == g2[None, :]).tolist()
+    )
