@@ -609,6 +609,147 @@ def exp_densespgemm(scale: int, sparsifier: str = "windowed"):
     return res
 
 
+def exp_pwindowed(m: int, ncol: int, density_pct: int, R: int):
+    """sparsify_windowed alone on an on-device synthetic [m, ncol] f32
+    dense matrix (threshold of threefry bits) — memory + rate isolation."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from combblas_tpu.ops.spgemm import sparsify_windowed
+
+    approx = int(m * ncol * density_pct / 100 * 1.1)
+    cap = 1 << max(int(approx) - 1, 1).bit_length()
+
+    @jax.jit
+    def run(key):
+        u = jax.random.uniform(key, (m, ncol), jnp.float32)
+        x = jnp.where(u < density_pct / 100.0, u + 0.5, 0.0)
+
+        def body(_, carry):
+            t, total = sparsify_windowed(x + carry, 0.0, m, ncol, cap)
+            return carry + total.astype(jnp.float32) * 0.0
+        tot = lax.fori_loop(0, R, body, jnp.float32(0.0))
+        _, total = sparsify_windowed(x, 0.0, m, ncol, cap)
+        return tot, total
+
+    key = jax.random.PRNGKey(0)
+    out = run(key)
+    jax.block_until_ready(out)
+    time.sleep(3.0)
+    dt_s = timed_once(lambda: run(key), lambda o: float(jax.device_get(o[0])))
+    return {
+        "experiment": f"pwindowed {m}x{ncol} d={density_pct}% R={R}",
+        "total": int(jax.device_get(out[1])),
+        "cap": cap,
+        "dt_s": round(dt_s, 4),
+        "Mcells_per_s": round(m * ncol * (R + 1) / dt_s / 1e6, 1),
+        "Mnnz_per_s": round(
+            int(jax.device_get(out[1])) * (R + 1) / dt_s / 1e6, 1),
+    }
+
+
+def exp_densewin2(scale: int):
+    """densespgemm variant: matmul and extraction as TWO jit programs
+    (device-resident handoff, no readback between) — isolates whether the
+    one-program composition triggers XLA remat of the matmul inside the
+    extraction's lax.map."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from scipy import sparse
+
+    from combblas_tpu.ops.spgemm import sparsify_windowed
+    from combblas_tpu.utils.rmat import rmat_symmetric_coo_host
+
+    n = 1 << scale
+    rows, cols = rmat_symmetric_coo_host(5, scale, 8)
+    key = rows * np.int64(n) + cols
+    uniq = np.unique(key)
+    ru = jnp.asarray((uniq // n).astype(np.int32))
+    cu = jnp.asarray((uniq % n).astype(np.int32))
+    S = sparse.csr_matrix(
+        (np.ones(len(uniq), np.float32), ((uniq // n), (uniq % n))),
+        shape=(n, n))
+    nnz_out = int((S @ S).nnz)
+    rdeg = np.bincount((uniq // n).astype(np.int64), minlength=n)
+    flops = float(np.sum(rdeg[(uniq % n).astype(np.int64)]))
+    cap = 1 << int(np.ceil(np.log2(nnz_out * 1.05)))
+
+    @jax.jit
+    def mm(r, c):
+        d = jnp.zeros((n, n), jnp.bfloat16)
+        d = d.at[r, c].set(jnp.bfloat16(1.0), mode="drop")
+        return jnp.dot(d, d, preferred_element_type=jnp.float32)
+
+    @jax.jit
+    def ext(c2):
+        t, total = sparsify_windowed(c2, 0.0, n, n, cap)
+        return t.rows, t.cols, t.vals, total
+
+    out = ext(mm(ru, cu))
+    jax.block_until_ready(out)
+    time.sleep(5.0)
+    dt_s = timed_once(lambda: ext(mm(ru, cu)),
+                      lambda o: int(jax.device_get(o[3])))
+    return {
+        "experiment": f"densewin2 scale={scale}",
+        "flops_M": round(flops / 1e6, 2),
+        "out_nnz": nnz_out,
+        "got_nnz": int(jax.device_get(out[3])),
+        "dt_s": round(dt_s, 4),
+        "MFLOPs_x2conv": round(2 * flops / dt_s / 1e6, 2),
+    }
+
+
+def exp_extreal(scale: int, source: str):
+    """sparsify_windowed alone on REAL A^2 data (host-computed, uploaded)
+    vs a uniform-random matrix of the same density — isolates whether the
+    38 s densespgemm anomaly is data-structure-dependent."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from scipy import sparse
+
+    from combblas_tpu.ops.spgemm import sparsify_windowed
+    from combblas_tpu.utils.rmat import rmat_symmetric_coo_host
+
+    n = 1 << scale
+    rows, cols = rmat_symmetric_coo_host(5, scale, 8)
+    key = rows * np.int64(n) + cols
+    uniq = np.unique(key)
+    S = sparse.csr_matrix(
+        (np.ones(len(uniq), np.float32), ((uniq // n), (uniq % n))),
+        shape=(n, n))
+    C = (S @ S).astype(np.float32)
+    nnz = int(C.nnz)
+    if source == "real":
+        x_h = np.asarray(C.todense(), np.float32)
+    else:
+        rng = np.random.default_rng(0)
+        x_h = np.where(rng.random((n, n)) < nnz / (n * n),
+                       1.0, 0.0).astype(np.float32)
+        nnz = int((x_h != 0).sum())
+    cap = 1 << int(np.ceil(np.log2(nnz * 1.05)))
+    x = jax.device_put(jnp.asarray(x_h))
+
+    @jax.jit
+    def ext(c2):
+        t, total = sparsify_windowed(c2, 0.0, n, n, cap)
+        return t.rows, t.cols, t.vals, total
+
+    out = ext(x)
+    jax.block_until_ready(out)
+    time.sleep(10.0)
+    dt_s = timed_once(lambda: ext(x), lambda o: int(jax.device_get(o[3])))
+    return {
+        "experiment": f"extreal scale={scale} source={source}",
+        "nnz": nnz,
+        "got": int(jax.device_get(out[3])),
+        "dt_s": round(dt_s, 4),
+    }
+
+
 def exp_cumsum2d(m: int, ncol: int, R: int):
     import jax
     import jax.numpy as jnp
@@ -686,6 +827,12 @@ def main():
         out = exp_densespgemm(int(a[0]), a[1] if len(a) > 1 else "windowed")
     elif exp == "pop":
         out = _pallas_op_chain(a[0], int(a[1]), int(a[2]))
+    elif exp == "pwindowed":
+        out = exp_pwindowed(int(a[0]), int(a[1]), int(a[2]), int(a[3]))
+    elif exp == "densewin2":
+        out = exp_densewin2(int(a[0]))
+    elif exp == "extreal":
+        out = exp_extreal(int(a[0]), a[1])
     elif exp == "cumsum2d":
         out = exp_cumsum2d(int(a[0]), int(a[1]), int(a[2]))
     elif exp == "topk":

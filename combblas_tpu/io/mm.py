@@ -69,13 +69,21 @@ def _load_native():
 
 
 def _read_mm_python(path):
-    """Pure-python fallback parser (header + body)."""
+    """Pure-python fallback parser (header + body).
+
+    Handles both ``coordinate`` (sparse) and ``array`` (dense,
+    column-major — ``src/mmio.c:60-70`` banner branch) formats; the dense
+    body is converted to COO triplets of its NONZERO entries (this is a
+    sparse library — explicit zeros in an array file carry no structure).
+    """
     with open(path, "rb") as f:
         banner = f.readline().decode()
         assert banner.startswith("%%MatrixMarket"), f"not MatrixMarket: {path}"
         b = banner.lower()
-        assert "coordinate" in b, "only coordinate (sparse) format supported"
+        dense = "array" in b
+        assert dense or "coordinate" in b, f"unknown MM format: {banner!r}"
         pattern = "pattern" in b
+        assert not (dense and pattern), "array+pattern is invalid MatrixMarket"
         sym = (
             2 if "skew-symmetric" in b else 1 if "symmetric" in b
             else 3 if "hermitian" in b else 0
@@ -83,6 +91,22 @@ def _read_mm_python(path):
         line = f.readline().decode()
         while line.startswith("%"):
             line = f.readline().decode()
+        if dense:
+            nrows, ncols = (int(x) for x in line.split()[:2])
+            body = np.loadtxt(f, dtype=np.float64, ndmin=1).reshape(-1)
+            if sym in (1, 2, 3):
+                # packed lower triangle (incl. diagonal), column-major
+                assert nrows == ncols, "symmetric array must be square"
+                r_t, c_t = np.tril_indices(nrows)
+                order = np.lexsort((r_t, c_t))  # column-major packing
+                full = np.zeros((nrows, ncols), np.float64)
+                full[r_t[order], c_t[order]] = body
+            else:
+                full = body.reshape((ncols, nrows)).T  # column-major
+            rows, cols = np.nonzero(full)
+            vals = full[rows, cols]
+            return (rows.astype(np.int64), cols.astype(np.int64), vals,
+                    nrows, ncols, sym)
         nrows, ncols, nnz = (int(x) for x in line.split()[:3])
         if pattern:
             data = np.loadtxt(f, dtype=np.int64, usecols=(0, 1), ndmin=2)
@@ -107,8 +131,13 @@ def read_mm(path, *, expand_symmetric: bool = True, nthreads: int | None = None)
     if lib is not None:
         hdr = (ctypes.c_int64 * 6)()
         rc = lib.mm_header(path.encode(), hdr)
-        if rc != 0:
+        if rc == 4:
+            # native parser is coordinate-only; dense "array" files take
+            # the python path (mmio.c:60-70 parity)
+            lib = None
+        elif rc != 0:
             raise ValueError(f"mm_header failed ({rc}) for {path}")
+    if lib is not None:
         nrows, ncols, nnz, _pattern, sym, _integer = (int(x) for x in hdr)
         rows = np.empty(max(nnz, 1), np.int64)
         cols = np.empty(max(nnz, 1), np.int64)

@@ -623,12 +623,13 @@ def _mcl3d_iter_device(A3, caps, inflation, prune_kwargs):
     C3 = make_col_stochastic3d(C3)
     ch = chaos3d(C3)
     A_next = inflate3d(C3, inflation)
-    big = jnp.int32(1 << 30)
-    overflow = jnp.maximum(
-        dropped.astype(jnp.int32),
-        jnp.maximum(
-            (flop_need > fcap).astype(jnp.int32) * big, ov_out * big
-        ),
+    # discriminated overflow bits (ADVICE r3: doubling all five caps on
+    # any flag wastes reroll memory/compiles): 1 = resplit stage/tile,
+    # 2 = expansion flops, 4 = output keys
+    overflow = (
+        (dropped > 0).astype(jnp.int32)
+        + (flop_need > fcap).astype(jnp.int32) * 2
+        + ov_out * 4
     )
     return A_next, ch, overflow
 
@@ -641,10 +642,15 @@ def _mcl3d_block_loop(A3, inflation, eps, max_iters, K, prune_kwargs):
     ch = float("inf")
     it = 0
     caps = None
+    dense_tile = None
     while it < max_iters:
         if caps is None:
             B3_probe = resplit3d(A3, "row")
             caps = _mcl3d_block_caps(A3, B3_probe)
+            g3 = A3.grid
+            dense_tile = A3.tile_rows * max(
+                B3_probe.ncols // max(g3.pc * g3.layers, 1), 1
+            )
         k = min(K, max_iters - it)
         A_entry = A3
         worst = jnp.int32(0)
@@ -653,8 +659,23 @@ def _mcl3d_block_loop(A3, inflation, eps, max_iters, K, prune_kwargs):
                 A3, caps, inflation, prune_kwargs
             )
             worst = jnp.maximum(worst, ov)
-        if int(worst) > 0:  # SYNC: reroll the block with doubled capacities
-            caps = tuple(c * 2 for c in caps)
+        bits = int(worst)
+        if (bits & 4) and caps[1] >= dense_tile:
+            # a dense-tile-sized output cannot truncate: nnz == ocap is a
+            # legitimately full tile, not an overflow (ADVICE r3)
+            bits &= ~4
+        if bits > 0:
+            # SYNC: reroll the block, doubling ONLY the overflowed group
+            # and clamping the out capacity at the dense tile (ADVICE r3)
+            fcap, ocap, pcap, stage_cap, tile_cap = caps
+            if bits & 1:
+                stage_cap, tile_cap = stage_cap * 2, tile_cap * 2
+            if bits & 2:
+                fcap, pcap = fcap * 2, pcap * 2
+            if bits & 4:
+                ocap = min(ocap * 2, max(dense_tile, 1))
+                pcap = pcap * 2
+            caps = (fcap, ocap, pcap, stage_cap, tile_cap)
             A3 = A_entry
             continue
         ch = float(ch_dev)

@@ -224,6 +224,10 @@ def sparsify_windowed(
     from .segment import expand_ranges
 
     R, C = dense.shape
+    # fence: without it XLA rematerializes the PRODUCER of `dense` (e.g.
+    # the whole MXU matmul) inside every lax.map step below — measured
+    # 39.8 s vs 1.4 s at scale 14 (probe_r4 densespgemm vs pwindowed)
+    dense = lax.optimization_barrier(dense)
     flat = dense.reshape(-1)
     ncell = R * C
     assert ncell % 128 == 0, (R, C)
@@ -233,37 +237,68 @@ def sparsify_windowed(
         mask = mask & (jnp.arange(C, dtype=jnp.int32)[None, :] < ncols)
     if R != nrows:
         mask = mask & (jnp.arange(R, dtype=jnp.int32)[:, None] < nrows)
-    m3 = mask.reshape(nch, 16, 8)
-    t8 = jnp.sum(m3, axis=2, dtype=jnp.int32)  # [nch, 16] group counts
+    # LAYOUT NOTE (the 16x-padding trap, probe_r4f): XLA:TPU tiles the two
+    # minor dims to (8, 128), so any [N, 16] / [N, 8] intermediate pads
+    # 8-16x — a [nch, 16, 8] view of the mask alone would materialize
+    # 4.3 GB at scale 14.  Group counts therefore come from ONE MXU
+    # matmul on the un-padded [nch, 128] layout, and the only [nch, 16]
+    # arrays are two transients immediately flattened to 1-D tables.
+    mrow = mask.reshape(nch, 128).astype(jnp.bfloat16)
+    gsel = (
+        lax.broadcasted_iota(jnp.int32, (128, 16), 0) // 8
+        == lax.broadcasted_iota(jnp.int32, (128, 16), 1)
+    ).astype(jnp.bfloat16)
+    t8 = jnp.dot(mrow, gsel, preferred_element_type=jnp.float32)
+    t8 = t8.astype(jnp.int32)  # [nch, 16] group counts (exact: <= 8)
     g8 = jnp.cumsum(t8, axis=1) - t8  # exclusive group prefix within chunk
+    g8f = g8.reshape(-1)  # flat 1-D table: no lane padding
     tch = jnp.sum(t8, axis=1)  # [nch] chunk counts
-    owner, t, valid, total = expand_ranges(tch, capacity)
+    g8f, tch = lax.optimization_barrier((g8f, tch))  # same remat fence
+    # output-slot arrays are cap-sized int32 (fine); the [slot, 16]/[slot,
+    # 8] narrowing intermediates are NOT (they pad to [slot, 128]) — so
+    # the narrowing runs as a lax.map over bounded slot chunks.
+    cs = min(1 << 18, max(capacity, 1 << 10))
+    cap_pad = -(-capacity // cs) * cs
+    owner, t, valid, total = expand_ranges(tch, cap_pad)
     owner = jnp.minimum(owner, nch - 1)
-    # level 1: 16-lane window of the chunk's group prefix
-    w16 = g8.reshape(-1)[owner[:, None] * 16
-                         + jnp.arange(16, dtype=jnp.int32)[None, :]]
-    le = w16 <= t[:, None]
-    b = jnp.sum(le, axis=1).astype(jnp.int32) - 1  # group index
-    r8 = t - jnp.max(jnp.where(le, w16, 0), axis=1)  # rank within group
-    # level 2: the group's 8 cells (values + mask) in one window each
-    gbase = (owner * 16 + b) * 8
-    w8 = flat[gbase[:, None] + jnp.arange(8, dtype=jnp.int32)[None, :]]
-    m8 = w8 != zero
-    if C != ncols or R != nrows:
+
+    def narrow(args):
+        owner, t, valid = args
+        # level 1: 16-lane window of the chunk's group prefix
+        w16 = g8f[owner[:, None] * 16
+                  + jnp.arange(16, dtype=jnp.int32)[None, :]]
+        le = w16 <= t[:, None]
+        b = jnp.sum(le, axis=1).astype(jnp.int32) - 1  # group index
+        r8 = t - jnp.max(jnp.where(le, w16, 0), axis=1)  # rank within group
+        # level 2: the group's 8 cells (values + mask) in one window each
+        gbase = (owner * 16 + b) * 8
         cell = gbase[:, None] + jnp.arange(8, dtype=jnp.int32)[None, :]
+        w8 = flat[cell]
+        m8 = w8 != zero
         if C != ncols:
             m8 = m8 & (cell % C < ncols)
         if R != nrows:
             m8 = m8 & (cell // C < nrows)
-    excl8 = jnp.cumsum(m8.astype(jnp.int32), axis=1) - m8.astype(jnp.int32)
-    sel = m8 & (excl8 == r8[:, None])  # exactly one lane per valid slot
-    lane = jnp.sum(jnp.where(sel, jnp.arange(8, dtype=jnp.int32)[None, :], 0),
-                   axis=1)
-    vals = jnp.sum(jnp.where(sel, w8, 0), axis=1)
-    fi = gbase + lane
-    rows = jnp.where(valid, fi // C, nrows).astype(jnp.int32)
-    cols = jnp.where(valid, fi % C, ncols).astype(jnp.int32)
-    vals = jnp.where(valid, vals, 0)
+        m8i = m8.astype(jnp.int32)
+        excl8 = jnp.cumsum(m8i, axis=1) - m8i
+        sel = m8 & (excl8 == r8[:, None])  # exactly one lane per valid slot
+        lane = jnp.sum(
+            jnp.where(sel, jnp.arange(8, dtype=jnp.int32)[None, :], 0), axis=1
+        )
+        vals = jnp.sum(jnp.where(sel, w8, 0), axis=1)
+        fi = gbase + lane
+        rows = jnp.where(valid, fi // C, nrows).astype(jnp.int32)
+        cols = jnp.where(valid, fi % C, ncols).astype(jnp.int32)
+        return rows, cols, jnp.where(valid, vals, 0)
+
+    ncb = cap_pad // cs
+    rows, cols, vals = lax.map(
+        narrow,
+        (owner.reshape(ncb, cs), t.reshape(ncb, cs), valid.reshape(ncb, cs)),
+    )
+    rows = rows.reshape(-1)[:capacity]
+    cols = cols.reshape(-1)[:capacity]
+    vals = vals.reshape(-1)[:capacity]
     return (
         SpTuples(
             rows=rows, cols=cols, vals=vals,

@@ -190,3 +190,36 @@ def test_read_mm_distributed_symmetric(tmp_path):
     d[0, 0], d[1, 0], d[0, 1] = 2.0, 3.0, 3.0
     d[2, 1], d[1, 2], d[3, 3] = 5.0, 5.0, 1.0
     np.testing.assert_allclose(A.to_dense(), d.astype(np.float32))
+
+
+def test_read_mm_array_general(tmp_path):
+    """Dense 'array' format (mmio.c:60-70 parity): column-major body,
+    nonzeros returned as COO."""
+    from combblas_tpu.io.mm import read_mm
+
+    p = tmp_path / "dense.mtx"
+    # column-major listing of [[1, 0], [2.5, 3]]
+    p.write_text(
+        "%%MatrixMarket matrix array real general\n"
+        "2 2\n1.0\n2.5\n0.0\n3.0\n"
+    )
+    rows, cols, vals, nr, nc = read_mm(str(p))
+    assert (nr, nc) == (2, 2)
+    got = sorted(zip(rows.tolist(), cols.tolist(), vals.tolist()))
+    assert got == [(0, 0, 1.0), (1, 0, 2.5), (1, 1, 3.0)]
+
+
+def test_read_mm_array_symmetric(tmp_path):
+    """Symmetric array: packed lower triangle, mirrored on expand."""
+    from combblas_tpu.io.mm import read_mm
+
+    p = tmp_path / "sym.mtx"
+    # lower triangle (incl diag) of [[1, 2], [2, 0]] column-major:
+    # column 0 rows 0..1 -> 1, 2; column 1 rows 1..1 -> 0
+    p.write_text(
+        "%%MatrixMarket matrix array real symmetric\n"
+        "2 2\n1.0\n2.0\n0.0\n"
+    )
+    rows, cols, vals, nr, nc = read_mm(str(p))
+    got = sorted(zip(rows.tolist(), cols.tolist(), vals.tolist()))
+    assert got == [(0, 0, 1.0), (0, 1, 2.0), (1, 0, 2.0)]

@@ -26,7 +26,8 @@ def _free_port() -> int:
     return port
 
 
-@pytest.mark.timeout(300)
+# (no pytest-timeout dependency here; the inner communicate(timeout=240)
+# bounds the workers — ADVICE r3 flagged the unregistered mark)
 def test_two_process_spmv_spgemm():
     worker = os.path.join(os.path.dirname(__file__), "_multihost_worker.py")
     coord = f"127.0.0.1:{_free_port()}"
