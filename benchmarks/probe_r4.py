@@ -627,8 +627,12 @@ def exp_pwindowed(m: int, ncol: int, density_pct: int, R: int):
         x = jnp.where(u < density_pct / 100.0, u + 0.5, 0.0)
 
         def body(_, carry):
-            t, total = sparsify_windowed(x + carry, 0.0, m, ncol, cap)
-            return carry + total.astype(jnp.float32) * 0.0
+            # fold-proof dependency: the carry perturbs the input by a
+            # data-dependent (but value-preserving) amount; a `* 0.0`
+            # dependency here was DCE'd and measured an empty program
+            t, total = sparsify_windowed(
+                x + (carry % jnp.float32(1e-30)), 0.0, m, ncol, cap)
+            return carry + jnp.minimum(total, 7).astype(jnp.float32)
         tot = lax.fori_loop(0, R, body, jnp.float32(0.0))
         _, total = sparsify_windowed(x, 0.0, m, ncol, cap)
         return tot, total
@@ -750,6 +754,53 @@ def exp_extreal(scale: int, source: str):
     }
 
 
+def exp_winform(nslots_m: int, W: int, form: str, R: int):
+    """Window-gather formulation shootout: nslots_m million slots each
+    fetching a W-lane window from a 33.5M-entry table.
+      flat   x[b0[:,None]+arange(W)]      (computed-index advanced indexing)
+      row2d  tab2d[owner] with tab [T/W, W]  (ELL bucket row gather)
+      take   jnp.take(tab2d, owner, axis=0)
+    Sum-reduced to a scalar carried through a fori_loop (fold-proof: the
+    carry feeds the next iteration's indices)."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    T = 1 << 25
+    nslots = nslots_m * 1_000_000
+    rng = np.random.default_rng(0)
+    tab = jax.device_put(jnp.asarray(rng.random(T).astype(np.float32)))
+    base = jax.device_put(jnp.asarray(
+        (rng.integers(0, T // W, size=nslots) * W).astype(np.int32)))
+    tab2d = tab.reshape(T // W, W)
+
+    @jax.jit
+    def run(tab, base):
+        def body(_, carry):
+            b = base + (carry.astype(jnp.int32) & 1)  # fold-proof dep
+            if form == "flat":
+                w = tab[b[:, None] + jnp.arange(W, dtype=jnp.int32)[None, :]]
+            elif form == "row2d":
+                w = tab2d[b // W]
+            else:
+                w = jnp.take(tab2d, b // W, axis=0)
+            return jnp.sum(w) * 1e-9
+        return lax.fori_loop(0, R, body, jnp.float32(0.0))
+
+    out = run(tab, base)
+    jax.block_until_ready(out)
+    time.sleep(5.0)
+    dt_s = timed_once(lambda: run(tab, base),
+                      lambda o: float(jax.device_get(o)))
+    return {
+        "experiment": f"winform {form} W={W} slots={nslots_m}M R={R}",
+        "dt_s": round(dt_s, 4),
+        "Mwindows_per_s": round(nslots * R / dt_s / 1e6, 1),
+        "Melem_per_s": round(nslots * W * R / dt_s / 1e6, 1),
+    }
+
+
 def exp_cumsum2d(m: int, ncol: int, R: int):
     import jax
     import jax.numpy as jnp
@@ -833,6 +884,8 @@ def main():
         out = exp_densewin2(int(a[0]))
     elif exp == "extreal":
         out = exp_extreal(int(a[0]), a[1])
+    elif exp == "winform":
+        out = exp_winform(int(a[0]), int(a[1]), a[2], int(a[3]))
     elif exp == "cumsum2d":
         out = exp_cumsum2d(int(a[0]), int(a[1]), int(a[2]))
     elif exp == "topk":

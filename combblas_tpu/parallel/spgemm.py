@@ -826,9 +826,13 @@ def summa_spgemm_mxu(
     return mat, overflow[0, 0]
 
 
-#: Above this local tile dimension the dense accumulator would exceed a
-#: few GB; the sort-based kernels take over.
-MXU_MAX_TILE_DIM = 32768
+#: Above this local tile dimension the dense path loses: not to the
+#: matmul (13.3 TFLOP/s bf16 — scale-14 tiles square in 0.7 s) but to the
+#: sparse-output EXTRACTION, which is point-gather/padding-bound at ~3 s+
+#: per 20M entries on the target chip (the full nine-design floor
+#: analysis: benchmarks/results/PERF_NOTES_r4.md).  The sort-based
+#: kernels take over beyond it.
+MXU_MAX_TILE_DIM = 8192
 
 
 def spgemm_auto(
