@@ -152,6 +152,15 @@ def k1_device_child(path: str):
 
     grid = Grid.make(1, 1)
     n = 1 << SCALE
+    # warmup pass: compiles every stage (the per-stage syncs are
+    # block_until_ready, not readbacks, so the process stays unpoisoned);
+    # the timed pass below then measures construction EXECUTION, matching
+    # the host path's semantics (the reference doesn't time compilation)
+    kernel1_device(
+        grid, SCALE, EDGEFACTOR, jax.random.PRNGKey(41),
+        compress_isolated=False,
+    )
+    time.sleep(float(os.environ.get("BENCH_K1_DRAIN_S", "15")))
     t0 = time.perf_counter()
     A, degrees, _nkeep, timings = kernel1_device(
         grid, SCALE, EDGEFACTOR, jax.random.PRNGKey(42),
