@@ -272,3 +272,25 @@ def test_densify_sparsify_roundtrip(rng):
     m = r < 20
     got[r[m], c[m]] = v[m]
     np.testing.assert_allclose(got, d)
+
+
+@pytest.mark.parametrize("mode", ["bf16", "bf16x3"])
+def test_spgemm_mxu_precision_modes(rng, mode):
+    """bf16 is EXACT on 0/1 inputs (counts < 2^24); bf16x3 split-float is
+    f32-grade on general values (round-4 _mxu_dot modes)."""
+    from combblas_tpu.parallel.spgemm import spgemm_auto
+
+    grid = Grid.make(2, 2)
+    n = 48
+    if mode == "bf16":
+        d = (rng.random((n, n)) < 0.2).astype(np.float32)
+    else:
+        d = random_dense(rng, n, n, 0.2)
+    A = SpParMat.from_dense(grid, d)
+    C = spgemm_auto(PLUS_TIMES, A, A, mode=mode, interpret=True)
+    got = np.asarray(C.to_dense())
+    want = d @ d
+    if mode == "bf16":
+        np.testing.assert_array_equal(got, want)  # exact
+    else:
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=1e-5)
