@@ -152,16 +152,22 @@ def k1_device_child(path: str):
     from combblas_tpu.models.graph500 import kernel1_device
     from combblas_tpu.parallel.grid import Grid
 
+    def log(msg):
+        print(f"[k1] {time.strftime('%H:%M:%S')} {msg}",
+              file=sys.stderr, flush=True)
+
     grid = Grid.make(1, 1)
     n = 1 << SCALE
     # warmup pass: compiles every stage (the per-stage syncs are
     # block_until_ready, not readbacks, so the process stays unpoisoned);
     # the timed pass below then measures construction EXECUTION, matching
     # the host path's semantics (the reference doesn't time compilation)
-    kernel1_device(
+    log("warmup start")
+    _, _, _, wt = kernel1_device(
         grid, SCALE, EDGEFACTOR, jax.random.PRNGKey(41),
         compress_isolated=False,
     )
+    log(f"warmup done {[ (k, round(v,1)) for k,v in wt.items() if k != 'dropped_dev' ]}")
     time.sleep(float(os.environ.get("BENCH_K1_DRAIN_S", "15")))
     t0 = time.perf_counter()
     A, degrees, _nkeep, timings = kernel1_device(
@@ -169,14 +175,25 @@ def k1_device_child(path: str):
         compress_isolated=False,
     )
     construction_s = time.perf_counter() - t0
+    log(f"timed pass done {construction_s:.1f}s")
+    # post-timing verification (first readback of this process): the
+    # deferred route-capacity drop count must be zero or the build is
+    # invalid and the parent falls back to the host kernel 1
+    dropped = int(np.asarray(jax.device_get(timings.pop("dropped_dev"))))
+    if dropped != 0:
+        raise SystemExit(f"kernel1_device dropped {dropped} tuples")
+    log("drop check ok; D2H start")
     # D2H serialization (untimed: the reference hands kernel 1's output to
     # kernel 2 in-memory; our process boundary is the axon-poison firewall)
     t = A.local_tile(A.rows, A.cols, A.vals, A.nnz)
     rows = np.asarray(jax.device_get(t.rows))
+    log("rows fetched")
     cols = np.asarray(jax.device_get(t.cols))
+    log("cols fetched")
     live = rows < n
     rows_u, cols_u = rows[live], cols[live]
     deg = np.asarray(jax.device_get(degrees.blocks)).reshape(-1)[:n]
+    log("deg fetched; writing npz")
     rng = np.random.default_rng(7)
     roots = rng.choice(np.flatnonzero(deg > 0), size=NROOTS, replace=False)
     np.savez(
@@ -338,7 +355,13 @@ def main():
     try:
         graph_path = os.path.join(tmp, "graph.npz")
         k1_info = None
-        if os.environ.get("BENCH_K1", "device") == "device":
+        # BENCH_K1=device runs the distributed kernel1_device pipeline in a
+        # dedicated process (k1_device_child). It works and is captured at
+        # scale 14 (per-stage timings in the r4 smoke artifact), but the
+        # axon REMOTE COMPILER takes >14 min to compile the route/dedup
+        # program at scale >= 17 (PERF_NOTES_r4), so the official default
+        # stays on the host kernel 1 to protect the driver's wall clock.
+        if os.environ.get("BENCH_K1", "host") == "device":
             # distributed kernel 1 in its own process (see k1_device_child)
             env = dict(os.environ)
             env["BENCH_K1_CHILD"] = "1"

@@ -39,6 +39,7 @@ from .parallel.ellmat import EllParMat
 from .parallel.spmat import SpParMat
 from .parallel.vec import DistVec
 from .parallel.spgemm import (
+    PhaseAdjustedWarning,
     block_spgemm,
     calculate_phases,
     estimate_flops,
@@ -47,6 +48,7 @@ from .parallel.spgemm import (
     spgemm,
     spgemm_auto,
     spgemm_scan,
+    summa_spgemm_mxu,
 )
 from .parallel.spmv import dist_spmspv, dist_spmv, dist_spmv_masked
 from .parallel.vec import DistMultiVec, concatenate
@@ -66,7 +68,7 @@ __all__ = [
     "DistVec",
     # distributed algebra
     "spgemm", "spgemm_scan", "spgemm_auto", "mem_efficient_spgemm",
-    "block_spgemm", "spgemm3d",
+    "block_spgemm", "spgemm3d", "summa_spgemm_mxu", "PhaseAdjustedWarning",
     "estimate_flops", "estimate_nnz_upper", "calculate_phases",
     "dist_spmv", "dist_spmv_masked", "dist_spmspv", "subsref", "spasgn",
     "concatenate", "DistMultiVec",

@@ -25,6 +25,7 @@ drop-retry check stays on device.
 
 from __future__ import annotations
 
+import os
 import time
 
 import jax
@@ -156,9 +157,17 @@ def kernel1_device(
     """
     from ..utils.rmat import rmat_edges
 
+    import sys
+
+    def _klog(msg):
+        if os.environ.get("BENCH_K1_LOG"):
+            print(f"[kernel1] {time.strftime('%H:%M:%S')} {msg}",
+                  file=sys.stderr, flush=True)
+
     timings: dict[str, float] = {}
     n = 1 << scale
     ndev = grid.pr * grid.pc
+    _klog("generate...")
 
     t0 = time.perf_counter()
     # generate (includes the spec's vertex scramble), symmetricize, de-loop
@@ -180,14 +189,22 @@ def kernel1_device(
     cols = jax.device_put(cols.reshape(shape), grid.tile_sharding())
     jax.block_until_ready((rows, cols))
     timings["generate_s"] = time.perf_counter() - t0
+    _klog(f"generate done {timings['generate_s']:.1f}s; route...")
 
     t0 = time.perf_counter()
     vals = jnp.ones(shape, jnp.float32)
-    A = from_device_coo(
-        grid, rows, cols, vals, n, n, slack=slack, dedup_sr=SELECT2ND_MAX
+    # defer_drop_check: the capacity-retry readback would POISON this
+    # process on the axon chip (bench.py docstring); the drop count rides
+    # along as a device scalar (timings["dropped_dev"]) for the caller to
+    # verify AFTER its timed section.
+    A, dropped = from_device_coo(
+        grid, rows, cols, vals, n, n, slack=slack, dedup_sr=SELECT2ND_MAX,
+        defer_drop_check=True,
     )
     jax.block_until_ready(A.vals)
     timings["route_dedup_s"] = time.perf_counter() - t0
+    timings["dropped_dev"] = dropped
+    _klog(f"route done {timings['route_dedup_s']:.1f}s")
 
     if extra_relabel:
         t0 = time.perf_counter()

@@ -189,11 +189,19 @@ def from_device_coo(
     slack: float = 2.0,
     max_retries: int = 3,
     dedup_sr: Semiring | None = None,
-) -> SpParMat:
+    defer_drop_check: bool = False,
+):
     """Convenience wrapper: size capacities from the chunk shape, route,
     and on drops retry with doubled capacities (skewed inputs — R-MAT hub
     columns — routinely exceed the balanced-load estimate). Raises only
-    after ``max_retries`` doublings."""
+    after ``max_retries`` doublings.
+
+    ``defer_drop_check=True`` returns ``(mat, dropped)`` with the drop
+    count as a DEVICE scalar and performs NO retries — for timed pipelines
+    on the axon chip, where the retry loop's readback would permanently
+    poison subsequent launches (bench.py module docstring); callers verify
+    ``int(dropped) == 0`` after their timed section and rerun with bigger
+    ``slack`` if not."""
     chunk = rows.shape[-1]
     # hop 2's buckets aggregate up to pc incoming hop-1 buckets, so size the
     # shared stage capacity from the larger of the two hops' balanced loads.
@@ -205,6 +213,14 @@ def from_device_coo(
     # total tuples = chunk * ndev over ndev tiles → ~chunk per tile.
     tile_cap = 1 << max(int(np.ceil(np.log2(max(chunk * slack, 1)))), 0)
     from .spgemm import host_value
+
+    if defer_drop_check:
+        mat, dropped = redistribute_coo(
+            grid, rows, cols, vals, nrows, ncols,
+            stage_capacity=stage_cap, tile_capacity=tile_cap,
+            dedup_sr=dedup_sr,
+        )
+        return mat, dropped
 
     nd = 0
     for _ in range(max_retries + 1):
