@@ -609,7 +609,7 @@ def bench_tc_dense():
     import jax
     import numpy as np
 
-    from combblas_tpu.models.tc import _tc_dense
+    from combblas_tpu.models.tc import _tc_combine, _tc_dense
     from combblas_tpu.parallel.grid import Grid
     from combblas_tpu.parallel.spmat import SpParMat
 
@@ -623,7 +623,7 @@ def bench_tc_dense():
     compiled = fn.lower(rows, cols, n).compile()
     time.sleep(2)
     t0 = time.perf_counter()
-    n_tri = int(jax.device_get(compiled(rows, cols)))
+    n_tri = _tc_combine(jax.device_get(compiled(rows, cols)))
     dt = time.perf_counter() - t0
     print(
         json.dumps(

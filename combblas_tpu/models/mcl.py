@@ -658,7 +658,10 @@ def _mcl3d_block_loop(A3, inflation, eps, max_iters, K, prune_kwargs):
             A3, ch_dev, ov = _mcl3d_iter_device(
                 A3, caps, inflation, prune_kwargs
             )
-            worst = jnp.maximum(worst, ov)
+            # ov carries discriminated BIT flags (1=resplit drop, 2=flop,
+            # 4=out-capacity): accumulate with OR — max(4, 3) would lose
+            # bits 1|2 across a K-iteration block (ADVICE r4)
+            worst = jnp.bitwise_or(worst, ov)
         bits = int(worst)
         if (bits & 4) and caps[1] >= dense_tile:
             # a dense-tile-sized output cannot truncate: nnz == ocap is a

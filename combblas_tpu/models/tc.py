@@ -25,9 +25,17 @@ def _tc_dense(rows, cols, n: int) -> jax.Array:
     """One-launch dense TC: sum((L·L) ⊙ L) on the MXU.
 
     bf16 0/1 inputs are exact; per-cell wedge counts < n < 2^24 are exact
-    in the f32 accumulator; the masked total is summed in int32.  No
-    sparse extraction at all — the mask IS the (tiny) output support, so
-    the whole computation is matmul + two elementwise passes.
+    in the f32 accumulator.  No sparse extraction at all — the mask IS
+    the (tiny) output support, so the whole computation is matmul + two
+    elementwise passes.
+
+    Returns an int32 [2] (hi, lo) split of the global triangle count:
+    the GLOBAL total can exceed 2^31 for dense graphs within
+    ``DENSE_MAX_DIM`` (a complete graph at n~3000 already would) while
+    int64 is unavailable without x64 mode (ADVICE r4).  Per-row sums are
+    int32-exact (< n^2 <= 2^30); each splits into 15-bit halves whose
+    column sums stay < n * 2^15 <= 2^30.  ``_tc_combine`` reassembles the
+    exact Python int (range 2^45 — beyond any n <= 32768 count).
     """
     npad = -(-n // 128) * 128
     keep = rows > cols  # strict lower triangle, loops dropped
@@ -37,7 +45,21 @@ def _tc_dense(rows, cols, n: int) -> jax.Array:
     d = d.at[r, c].set(jnp.bfloat16(1.0), mode="drop")
     wedges = jnp.dot(d, d, preferred_element_type=jnp.float32)
     masked = wedges * d.astype(jnp.float32)
-    return jnp.sum(masked.astype(jnp.int32))
+    # cast per CELL before the row sum: cells are f32-exact (< n < 2^24)
+    # but an f32 row accumulation would round past 2^24; int32 row sums
+    # are exact below n^2 <= 2^30
+    rowsum = jnp.sum(masked.astype(jnp.int32), axis=1)
+    hi = jnp.sum(rowsum >> 15)
+    lo = jnp.sum(rowsum & 0x7FFF)
+    return jnp.stack([hi, lo])
+
+
+def _tc_combine(hilo) -> int:
+    """Exact host-side total from ``_tc_dense``'s (hi, lo) split."""
+    import numpy as np
+
+    hilo = np.asarray(hilo, np.int64)
+    return int((hilo[0] << 15) + hilo[1])
 
 
 def triangle_count(A: SpParMat, kernel: str = "auto") -> int:
@@ -60,7 +82,9 @@ def triangle_count(A: SpParMat, kernel: str = "auto") -> int:
         )
     if kernel == "dense":
         t = A.local_tile(A.rows, A.cols, A.vals, A.nnz)
-        return int(jax.jit(_tc_dense, static_argnums=2)(t.rows, t.cols, A.nrows))
+        return _tc_combine(
+            jax.jit(_tc_dense, static_argnums=2)(t.rows, t.cols, A.nrows)
+        )
     L = A.remove_loops().tril(strict=True).apply(ones_f32)
     B = spgemm(PLUS_TIMES, L, L)  # B[i,j] = # wedges i->k->j with i>k>j
     C = B.ewise_mult(L)  # keep wedge counts only where edge (i,j) closes

@@ -150,7 +150,13 @@ def _pack_kernel(
     chosen = jnp.int32(ladder[-1])
     for b in reversed(ladder):
         chosen = jnp.where(rows_used8 <= b, jnp.int32(b), chosen)
-    fired = (total > 0) & (off + chosen <= cap_rows)
+    # fire on the ADVANCE amount (rows_used8), not the bucket size: off
+    # only ever grows by rows_used8, so `off + rows_used8 <= cap_rows` is
+    # the exact "fits" test, and the bucket DMA's overhang past cap_rows
+    # (chosen - rows_used8 < pr rows) lands in the pad_rows slack
+    # allocated for exactly this (ADVICE r4: comparing the bucket-rounded
+    # `chosen` dropped panels whole even when total <= capacity)
+    fired = (total > 0) & (off + rows_used8 <= cap_rows)
     for b in ladder:
 
         @pl.when(fired & (chosen == b))

@@ -76,6 +76,9 @@ def test_kernel1_device_matches_host(grid_shape):
     hr, hc = uniq // n, uniq % n
     hdeg = np.bincount(hr, minlength=n)
 
+    # kernel1_device defers its routing-capacity drop check (axon D2H
+    # rule); a caller that skips it would silently lose edges (ADVICE r4)
+    assert int(np.asarray(timings["dropped_dev"])) == 0
     assert int(np.asarray(A.getnnz())) == len(uniq)
     assert int(nkeep) == int((hdeg > 0).sum())
     # degree multiset is relabel-invariant
@@ -92,8 +95,10 @@ def test_kernel1_extra_relabel_isomorphic():
     grid = Grid.make(2, 2)
     scale, ef = 6, 8
     key = jax.random.key(5)
-    A1, deg1, nk1, _ = kernel1_device(grid, scale, ef, key)
-    A2, deg2, nk2, _ = kernel1_device(grid, scale, ef, key, extra_relabel=True)
+    A1, deg1, nk1, t1 = kernel1_device(grid, scale, ef, key)
+    A2, deg2, nk2, t2 = kernel1_device(grid, scale, ef, key, extra_relabel=True)
+    assert int(np.asarray(t1["dropped_dev"])) == 0
+    assert int(np.asarray(t2["dropped_dev"])) == 0
     assert int(nk1) == int(nk2)
     assert int(np.asarray(A1.getnnz())) == int(np.asarray(A2.getnnz()))
     np.testing.assert_array_equal(

@@ -119,3 +119,49 @@ def test_gcd_panel_fallback():
     fi = np.asarray(fi)
     live = fi >= 0
     assert int(live[: int(end_row) * 128].sum()) == 24
+
+
+@pytest.mark.parametrize(
+    "total_nnz, pr",
+    [
+        (5120, 64),   # rows_used8 = 40 -> bucket 64 > cap_rows slack
+        (1152, 32),   # rows_used8 = 9*128/128 -> 16-bucket round-up
+        (4224, 64),   # 33 rows -> 40 aligned -> bucket 64
+    ],
+)
+def test_exact_capacity_bucket_roundup(total_nnz, pr):
+    """capacity == total must write every panel even when the DMA bucket
+    rounds above the per-panel slack (ADVICE r4: the old fire test
+    compared the bucket-rounded row count against cap_rows and silently
+    dropped the panel — capacity=total=5120 returned nnz=0)."""
+    rng = np.random.default_rng(total_nnz + pr)
+    M, N = pr, 128  # one panel
+    x = np.zeros((M, N), np.float32)
+    flat = rng.choice(M * N, size=total_nnz, replace=False)
+    x.reshape(-1)[flat] = 1.0
+    t, total = dense_to_sptuples(
+        jnp.asarray(x), M, N, capacity=total_nnz, panel_rows=pr,
+        interpret=True,
+    )
+    assert int(total) == total_nnz
+    r, c, v = _extract(t, M, N)
+    assert len(r) == total_nnz, "panel dropped at exact capacity"
+    r_ref, c_ref = np.nonzero(x != 0)
+    assert sorted(zip(r.tolist(), c.tolist())) == sorted(
+        zip(r_ref.tolist(), c_ref.tolist())
+    )
+
+
+def test_exact_capacity_multi_panel():
+    """Two panels, capacity == total, both with bucket round-up."""
+    rng = np.random.default_rng(9)
+    M, N = 32, 256  # R = 64 flat rows, pr=32 -> 2 panels
+    x = np.where(rng.random((M, N)) < 0.35, 1.0, 0.0).astype(np.float32)
+    total_nnz = int((x != 0).sum())
+    t, total = dense_to_sptuples(
+        jnp.asarray(x), M, N, capacity=total_nnz, panel_rows=32,
+        interpret=True,
+    )
+    assert int(total) == total_nnz
+    r, c, _ = _extract(t, M, N)
+    assert len(r) == total_nnz
