@@ -1,7 +1,41 @@
 """Graph500 BFS benchmark on the real TPU chip.
 
-Prints ONE JSON line:
+Prints INCREMENTAL JSON lines; the LAST line is the official record:
   {"metric": ..., "value": N, "unit": "MTEPS", "vs_baseline": N, ...}
+
+ROUND-5 PROTOCOL (VERDICT r4 items 1+8 — the r4 driver capture timed out
+with an empty tail because the single JSON line printed only after a
+30-45 min protocol):
+  * INCREMENTAL OUTPUT: a COMPLETE official line is printed (flushed)
+    immediately after the repeat phase; the line is then re-printed,
+    enriched, after EVERY sequential-root child. A driver timeout at any
+    point still finds a complete, parseable last line.
+  * BUDGET BOUNDING: the protocol sizes itself to BENCH_BUDGET_S
+    (default 1200 s): the 3 validated repeats always run; sequential
+    roots run newest-estimate-first only while they fit the remaining
+    budget, and the artifact records how many fit ("seq_roots_timed").
+  * COMPILE-CACHE PERSISTENCE: every child sets
+    jax_compilation_cache_dir=.jax_cache (verified to work through the
+    axon remote compiler: 2.7 s -> 0.5 s cold-process recompile), so
+    across-children and across-run warmups collapse to load time.
+  * OFFICIAL-RUN RULE (predeclared, VERDICT r4 Weak #5): the canonical
+    artifact for a round is the DRIVER's end-of-round capture
+    (BENCH_r{N}.json), i.e. the last complete JSON line of that run.
+    Builder-side runs are archived under benchmarks/results/ as
+    supplementary evidence only; where several builder runs exist, the
+    FIRST complete run of bench day is the one quoted in PERF_NOTES.
+  * REPEAT REPLACEMENT (VERDICT r4 Weak #6): if any repeat lands >2x
+    below the operating point (or fails), exactly ONE replacement repeat
+    is appended; the original stays in "runs" and the median is taken
+    over all successful repeats.
+  * HEADLINE (VERDICT r4 Weak #3): "value"/"vs_baseline" carry the
+    SPEC's sequential per-root statistic (harmonic-mean MTEPS over
+    individually-timed roots — the only number apples-to-apples with
+    BASELINE.md) once at least 4 sequential roots have been timed; the
+    amortized batch median is reported alongside as
+    "batch_median_mteps"/"batch_vs_baseline". Before that point (line 1,
+    or a timeout before 4 roots) the batch median is the value and
+    "statistic" says so.
 
 Protocol (adapted from the reference's TopDownBFS driver,
 TopDownBFS.cpp:421-479): R-MAT scale-S graph (edgefactor 16, symmetrized,
@@ -51,6 +85,15 @@ PER-ROOT STATISTICS (round 4: BOTH are reported):
     needs a D2H sync and the first readback poisons a process (below).
     "seq_harmonic_mean_mteps" is the only number apples-to-apples with
     BASELINE.md (which stores exactly this statistic).
+    ROUND 5: the sequential child runs models/bfs.py:bfs_single — the
+    FRONTIER-PROPORTIONAL tiered kernel (budgeted sparse column walks +
+    dense sweep chosen per level on device, parents carried in the
+    gathers) instead of the W=1 batched kernel whose every level paid a
+    frontier-independent O(nnz) gather (VERDICT r4 Missing #1; the
+    reference's top-down property, BFSFriends.h:59-182). Tier spec:
+    BENCH_SEQ_TIERS="td:F0,..,F5|bu:F0,..,F5|..." — per-degree-class
+    vertex budgets on models/bfs.py:BFS_CLASS_LADDER; an untimed warmup
+    child populates the compile cache before the timed roots.
 
 VALIDATION (round 4): each repeat child runs the device-side Graph500
 tree checks (models/bfs.py:validate_bfs_device) AFTER its timed readback
@@ -102,17 +145,48 @@ VALIDATE = os.environ.get("BENCH_VALIDATE", "1") == "1"
 # Reported as the harmonic-mean per-root MTEPS next to the amortized
 # batched statistic; this is the only number comparable with BASELINE.md.
 SEQ_ROOTS = int(os.environ.get("BENCH_SEQ_ROOTS", "16"))
-# single-root warmup executions are short; 20 s covers them (the W=256
-# repeats keep the full 45 s drain)
-SEQ_DRAIN_S = float(os.environ.get("BENCH_SEQ_DRAIN_S", "20"))
+# single-root warmup executions are short (the frontier-proportional
+# kernel's whole traversal is ~1-2 s); the W=256 repeats keep the 45 s
+SEQ_DRAIN_S = float(os.environ.get("BENCH_SEQ_DRAIN_S", "10"))
+# wall-clock budget the whole protocol must fit (driver timeout guard);
+# repeats always run, sequential roots fill the remainder
+BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "1200"))
+# frontier-proportional tier ladder for the sequential child:
+# "frontier_cap:edge_cap,..." ascending; beyond the last tier a level
+# runs the dense sweep (the bottom-up regime)
+# class-budget tier ladder (see models/bfs.py parse_tier_spec): a
+# small top-down tier for the pre-peak levels, two bottom-up tiers for
+# the post-peak levels (measured scale-20 level anatomy: one dense step
+# per traversal), dense for the peak
+SEQ_TIERS = os.environ.get(
+    "BENCH_SEQ_TIERS",
+    "td:1024,1024,512,128,16,2"
+    "|bu:524288,16384,1024,0,0,0"
+    "|bu:1048576,32768,2048,128,0,0",
+)
 BASELINE_MTEPS = 1636.0  # Hopper 1024 cores, R-MAT "mini"
 OPERATING_MTEPS = 297.0  # recorded sweep at scale 20 / W=256 (r2h)
+CACHE_DIR = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), ".jax_cache"
+)
+
+
+def _enable_compile_cache():
+    """Persistent compilation cache (works through the axon remote
+    compiler — measured 2.7 s -> 0.5 s cold-process recompile): children
+    share compiled programs with each other and with prior runs, so the
+    16 sequential-root processes compile bfs_single exactly once."""
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", CACHE_DIR)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
 
 
 def build_graph_npz(path: str) -> float:
     """Kernel 1, host path: R-MAT generate + symmetricize + dedup; returns
-    construction seconds (graph build only; per-child ELL bucketing and
-    upload are timed separately as construction_child_s)."""
+    construction seconds (graph build only; the search structures are
+    added by augment_npz_with_structures and timed separately)."""
     import numpy as np
 
     from combblas_tpu.utils.rmat import rmat_symmetric_coo_host
@@ -136,6 +210,41 @@ def build_graph_npz(path: str) -> float:
         roots=roots.astype(np.int32),
     )
     return dt
+
+
+def augment_npz_with_structures(path: str) -> float:
+    """Kernel-1 tail, host: build the ELL buckets + CSC companion ONCE in
+    the parent (numpy only — the parent never attaches to the chip) and
+    append them to the graph .npz, so every timing child just uploads.
+    Returns build seconds (counted into construction_s: the reference's
+    kernel 1 likewise includes assembling the search structure,
+    SpParMat.cpp:3343 OptimizeForGraph500)."""
+    import numpy as np
+
+    from combblas_tpu.parallel.ellmat import (
+        EllParMat,
+        build_csc_companion_host,
+    )
+    from combblas_tpu.parallel.grid import HostGrid
+
+    t0 = time.perf_counter()
+    z = dict(np.load(path))
+    grid = HostGrid(1, 1)
+    n = 1 << SCALE
+    buckets = EllParMat.host_build(
+        grid, z["rows"], z["cols"],
+        np.zeros(len(z["rows"]), np.int8), n, n,
+    )
+    indptr, rowidx = build_csc_companion_host(
+        grid, z["rows"], z["cols"], n, n
+    )
+    z["csc_indptr"], z["csc_rowidx"] = indptr, rowidx
+    z["ell_nbuckets"] = np.int32(len(buckets))
+    for b, (bc, _bv, br) in enumerate(buckets):
+        z[f"ell{b}_bc"] = bc
+        z[f"ell{b}_br"] = br
+    np.savez(path, **z)
+    return time.perf_counter() - t0
 
 
 def k1_device_child(path: str):
@@ -210,39 +319,151 @@ def k1_device_child(path: str):
     }))
 
 
-def child(graph_path: str):
+def _load_structures(grid, data, n, want_csc=True):
+    """Upload the parent-prebuilt ELL buckets (+ CSC companion when the
+    caller walks columns — ``want_csc=False`` skips its ~4B/nnz upload
+    in the plain batched repeats) from the .npz, falling back to
+    in-child construction for an un-augmented graph file."""
+    import numpy as np
+
+    from combblas_tpu.parallel.ellmat import (
+        EllParMat,
+        build_csc_companion,
+        upload_csc_companion,
+    )
+
+    if "ell_nbuckets" in data:
+        nb = int(data["ell_nbuckets"])
+        host_buckets = [
+            (
+                data[f"ell{b}_bc"],
+                np.zeros(data[f"ell{b}_bc"].shape, np.int8),
+                data[f"ell{b}_br"],
+            )
+            for b in range(nb)
+        ]
+        E = EllParMat.from_host_buckets(grid, host_buckets, n, n)
+        csc = (
+            upload_csc_companion(
+                grid, data["csc_indptr"], data["csc_rowidx"]
+            )
+            if want_csc else None
+        )
+    else:
+        rows_u, cols_u = data["rows"], data["cols"]
+        E = EllParMat.from_host_coo(
+            grid, rows_u, cols_u,
+            np.zeros(len(rows_u), np.int8), n, n,
+        )
+        csc = (
+            build_csc_companion(grid, rows_u, cols_u, n, n)
+            if want_csc else None
+        )
+    return E, csc
+
+
+def seq_child(graph_path: str, seq_idx: int):
+    """Sequential-statistic child: ONE root, frontier-proportional
+    tiered BFS (bfs_single), one launch, own process."""
+    _enable_compile_cache()
     import jax
     import numpy as np
 
-    from combblas_tpu.models.bfs import batch_traversed_edges, bfs_batch_compact
-    from combblas_tpu.parallel.ellmat import EllParMat
+    from combblas_tpu.models.bfs import bfs_single, single_traversed_edges
     from combblas_tpu.parallel.grid import Grid
     from combblas_tpu.parallel.vec import DistVec
 
     grid = Grid.make(1, 1)
     n = 1 << SCALE
 
-    # --- Phase 1: host-only load + bucketing ------------------------------
     t0 = time.perf_counter()
     data = np.load(graph_path)
-    rows_u, cols_u = data["rows"], data["cols"]
-    deg, roots = data["deg"], data["roots"]
-    seq_idx = os.environ.get("BENCH_SEQ_ROOT_IDX")
-    if seq_idx is not None:
-        # sequential-statistic child: ONE root, one launch, own process
-        roots = roots[int(seq_idx) : int(seq_idx) + 1]
-    nnz = len(rows_u)
+    root = np.int32(data["roots"][seq_idx])
+    E, csc = _load_structures(grid, data, n)
+    deg_blocks = DistVec.from_global(grid, data["deg"], align="row").blocks
+    # symmetric graph: per-column degrees == per-row degrees; host-built
+    # (deriving them from the CSC indptr on device hits the chip's
+    # pathological megascale-1-D path, probe_seq_r5 mode v6)
+    coldeg_blocks = DistVec.from_global(grid, data["deg"], align="col").blocks
+    from combblas_tpu.models.bfs import parse_tier_spec
 
-    # --- Phase 2: upload (H2D only) ---------------------------------------
-    E = EllParMat.from_host_coo(
-        grid, rows_u, cols_u, np.ones(nnz, np.float32), n, n
-    )
+    tiers = parse_tier_spec(SEQ_TIERS)
+    construction_child_s = time.perf_counter() - t0
+
+    # warmup (compile via the persistent cache + one full execution)
+    t0 = time.perf_counter()
+    p, _, _ = bfs_single(E, root, csc, csr=csc, tiers=tiers,
+                         coldeg=coldeg_blocks, rowdeg=deg_blocks)
+    te_dev = single_traversed_edges(deg_blocks, p)
+    jax.block_until_ready(te_dev)
+    warmup_s = time.perf_counter() - t0
+    time.sleep(SEQ_DRAIN_S)
+
+    t0 = time.perf_counter()
+    p, l, niter = bfs_single(E, root, csc, csr=csc, tiers=tiers,
+                             coldeg=coldeg_blocks, rowdeg=deg_blocks)
+    te_dev = single_traversed_edges(deg_blocks, p)
+    te = int(np.asarray(jax.device_get(te_dev)))  # true barrier
+    dt = time.perf_counter() - t0
+
+    out = {
+        "mteps": round(te / dt / 1e6, 4),
+        "dt_s": round(dt, 4),
+        "warmup_s": round(warmup_s, 2),
+        "drain_s": SEQ_DRAIN_S,
+        "total_traversed_edges": te,
+        "levels": int(np.asarray(jax.device_get(niter))),
+        "root_index": int(seq_idx),
+        "construction_child_s": round(construction_child_s, 2),
+    }
+    if VALIDATE and os.environ.get("BENCH_SEQ_VALIDATE_THIS") == "1":
+        # the headline statistic's kernel gets the same device-side tree
+        # checks as the batch path (predeclared: the FIRST timed root
+        # validates; the launch runs post-readback/poisoned — slow but
+        # harmless to the timing)
+        import jax.numpy as jnp
+
+        from combblas_tpu.models.bfs import validate_bfs_device
+        from combblas_tpu.parallel.vec import DistMultiVec
+
+        mv = lambda v, dt_: DistMultiVec(
+            blocks=v.blocks[:, :, None].astype(dt_), length=v.length,
+            align=v.align, grid=v.grid,
+        )
+        v = np.asarray(jax.device_get(validate_bfs_device(
+            E, mv(p, jnp.int32), mv(l, jnp.int32)
+        )))
+        out["validation"] = {
+            "roots_bad": int(v[0].sum()),
+            "level_step_bad": int(v[1].sum()),
+            "tree_edge_bad": int(v[2].sum()),
+            "edge_consistency_bad": int(v[3].sum()),
+        }
+    print(json.dumps(out), flush=True)
+
+
+def child(graph_path: str):
+    _enable_compile_cache()
+    import jax
+    import numpy as np
+
+    from combblas_tpu.models.bfs import batch_traversed_edges, bfs_batch_compact
+    from combblas_tpu.parallel.grid import Grid
+    from combblas_tpu.parallel.vec import DistVec
+
+    grid = Grid.make(1, 1)
+    n = 1 << SCALE
+
+    # --- Phase 1+2: host-only load, then upload (H2D only) ----------------
+    t0 = time.perf_counter()
+    data = np.load(graph_path)
+    deg, roots = data["deg"], data["roots"]
+    nnz = len(data["rows"])
+    E, csc_arrays = _load_structures(grid, data, n, want_csc=DIROPT)
     csc = None
     fcap = ecap = None
     if DIROPT:
-        from combblas_tpu.parallel.ellmat import build_csc_companion
-
-        csc = build_csc_companion(grid, rows_u, cols_u, n, n)
+        csc = csc_arrays
         fcap = grid.local_cols(n) // 8
         ecap = max(nnz // 16, 1 << 20)
     deg_blocks = DistVec.from_global(grid, deg, align="row").blocks
@@ -261,7 +482,7 @@ def child(graph_path: str):
     te_dev = batch_traversed_edges(deg_blocks, p)
     jax.block_until_ready(te_dev)
     warmup_s = time.perf_counter() - t0
-    time.sleep(SEQ_DRAIN_S if seq_idx is not None else DRAIN_S)
+    time.sleep(DRAIN_S)
 
     t0 = time.perf_counter()
     parents, levels, _ = bfs_batch_compact(
@@ -272,7 +493,7 @@ def child(graph_path: str):
     dt = time.perf_counter() - t0
 
     validation = None
-    if VALIDATE and seq_idx is None:
+    if VALIDATE:
         # Graph500 tree validation ON DEVICE (verify.c intent) — after the
         # timed section (the readback above already poisoned this process,
         # so the validation launch is slow but harmless to the timing).
@@ -322,7 +543,7 @@ def child(graph_path: str):
         "harmonic_mean_amortized_mteps": round(float(hm), 2),
         "dt_s": round(dt, 3),
         "warmup_s": round(warmup_s, 2),
-        "drain_s": SEQ_DRAIN_S if seq_idx is not None else DRAIN_S,
+        "drain_s": DRAIN_S,
         "total_traversed_edges": total_te,
         "roots": int(W),
         "reachable_roots": int((te > 0).sum()),
@@ -330,18 +551,114 @@ def child(graph_path: str):
     }
     if validation is not None:
         out["validation"] = validation
-    if seq_idx is not None:
-        out["root_index"] = int(seq_idx)
-    if mteps < OPERATING_MTEPS / 2 and SCALE == 20 and NROOTS == 256 \
-            and seq_idx is None:
+    if mteps < OPERATING_MTEPS / 2 and SCALE == 20 and NROOTS == 256:
         out["warning"] = (
             f"{mteps:.1f} MTEPS is >2x below the recorded operating point "
             f"({OPERATING_MTEPS}); suspect drain/compile-cache/chip state"
         )
-    print(json.dumps(out))
+    print(json.dumps(out), flush=True)
+
+
+def emit(runs, seq_runs, construction_s, k1_info, t_start):
+    """Assemble and PRINT (flushed) the official JSON line from whatever
+    has completed so far — called after the repeat phase and again after
+    every sequential-root child, so a driver timeout at any point still
+    finds a complete last line (VERDICT r4 Weak #1)."""
+    ok = sorted(
+        (r for r in runs if r.get("mteps", 0) > 0), key=lambda r: r["mteps"]
+    )
+    # median REPEAT: value and the per-root statistic come from the same run
+    med_run = ok[(len(ok) - 1) // 2] if ok else {}
+    median = med_run.get("mteps", 0.0)
+    # Graph500-spec sequential statistic: harmonic mean of per-root TEPS
+    # over the individually-timed roots (each its own process)
+    seq_ok = [
+        r for r in seq_runs
+        if r.get("mteps", 0) > 0 and r.get("total_traversed_edges", 0) > 0
+    ]
+    seq_hm = (
+        len(seq_ok) / sum(1.0 / r["mteps"] for r in seq_ok) if seq_ok else 0.0
+    )
+    # HEADLINE RULE (docstring): the spec's sequential statistic is the
+    # value once >= 4 roots are individually timed; the amortized batch
+    # median otherwise (and always alongside as batch_median_mteps).
+    spec_headline = len(seq_ok) >= 4
+    value = seq_hm if spec_headline else median
+    out = {
+        "metric": f"graph500_bfs_rmat_scale{SCALE}_1chip_MTEPS",
+        "value": round(value, 2),
+        "unit": "MTEPS",
+        "vs_baseline": round(value / BASELINE_MTEPS, 6),
+        "statistic": (
+            "seq_per_root_harmonic_mean" if spec_headline
+            else "amortized_batch_median"
+        ),
+        "batch_median_mteps": round(median, 2),
+        "batch_vs_baseline": round(median / BASELINE_MTEPS, 4),
+        "repeats_mteps": [r.get("mteps", 0.0) for r in runs],
+        "harmonic_mean_amortized_mteps": med_run.get(
+            "harmonic_mean_amortized_mteps", 0.0
+        ),
+        "seq_harmonic_mean_mteps": round(seq_hm, 3),
+        "seq_roots_timed": len(seq_ok),
+        "seq_roots_planned": min(SEQ_ROOTS, NROOTS),
+        "seq_per_root_mteps": [r.get("mteps", 0.0) for r in seq_runs],
+        "seq_vs_baseline": round(seq_hm / BASELINE_MTEPS, 6),
+        "construction_s": round(construction_s, 2),
+        "construction": k1_info,
+        "validation": med_run.get("validation"),
+        "seq_validation": next(
+            (r["validation"] for r in seq_ok if r.get("validation")), None
+        ),
+        "validated": bool(
+            ok
+            and all(
+                r.get("validation") is not None
+                and not any(
+                    v for k, v in r["validation"].items() if k.endswith("_bad")
+                )
+                for r in ok
+            )
+            # when the headline IS the seq statistic, its kernel's tree
+            # check must also be clean
+            and (
+                not spec_headline
+                or any(
+                    r.get("validation") is not None
+                    and not any(
+                        v for k, v in r["validation"].items()
+                        if k.endswith("_bad")
+                    )
+                    for r in seq_ok
+                )
+            )
+        ),
+        "budget_s": BUDGET_S,
+        "elapsed_s": round(time.perf_counter() - t_start, 1),
+        "runs": runs,
+        "seq_runs": seq_runs,
+    }
+    if not ok:
+        out["error"] = (
+            "no repeat produced a valid measurement; see 'runs' for "
+            "per-child diagnostics"
+        )
+    if median < OPERATING_MTEPS / 2 and SCALE == 20 and NROOTS == 256:
+        out["warning"] = (
+            f"batch median {median:.1f} MTEPS >2x below operating point "
+            f"{OPERATING_MTEPS}; see per-run diagnostics in 'runs'"
+        )
+    print(json.dumps(out), flush=True)
 
 
 def main():
+    t_start = time.perf_counter()
+    if os.environ.get("BENCH_SEQ_ROOT_IDX") is not None:
+        seq_child(
+            os.environ["BENCH_GRAPH_NPZ"],
+            int(os.environ["BENCH_SEQ_ROOT_IDX"]),
+        )
+        return
     if os.environ.get("BENCH_CHILD"):
         child(os.environ["BENCH_GRAPH_NPZ"])
         return
@@ -350,6 +667,9 @@ def main():
         return
 
     import shutil
+
+    def remaining():
+        return BUDGET_S - (time.perf_counter() - t_start)
 
     tmp = tempfile.mkdtemp(prefix="bench_g500_")
     try:
@@ -384,10 +704,15 @@ def main():
             # fallback: host kernel 1 (and say so in the artifact)
             k1_info = {"fallback": "host numpy kernel 1"}
             construction_s = build_graph_npz(graph_path)
+        # search-structure assembly (ELL buckets + CSC companion), ONCE,
+        # in the parent — part of kernel 1 (OptimizeForGraph500 role),
+        # counted into construction_s; children only upload.
+        structures_s = augment_npz_with_structures(graph_path)
+        construction_s += structures_s
+        k1_info["structures_s"] = round(structures_s, 2)
 
         def run_child(extra_env):
             env = dict(os.environ)
-            env["BENCH_CHILD"] = "1"
             env["BENCH_GRAPH_NPZ"] = graph_path
             env.update(extra_env)
             try:
@@ -406,70 +731,55 @@ def main():
             except json.JSONDecodeError:
                 return {"mteps": 0.0, "error": stderr_tail}
 
-        runs = [run_child({}) for _ in range(max(REPEATS, 1))]
-        # spec-comparable sequential statistic: one process per root
-        seq_runs = [
-            run_child({"BENCH_SEQ_ROOT_IDX": str(i), "BENCH_NROOTS": "1"})
-            for i in range(min(SEQ_ROOTS, NROOTS))
+        runs = [
+            run_child({"BENCH_CHILD": "1"}) for _ in range(max(REPEATS, 1))
         ]
+        # REPEAT REPLACEMENT (predeclared; VERDICT r4 Weak #6): one extra
+        # repeat if any landed >2x below the operating point or failed;
+        # the original stays in "runs", the median absorbs both.
+        if any(r.get("warning") or r.get("mteps", 0) <= 0 for r in runs):
+            runs.append(run_child({"BENCH_CHILD": "1"}))
+            runs[-1]["replacement"] = True
+
+        seq_runs = []
+        # line 1: complete official record before any sequential root
+        emit(runs, seq_runs, construction_s, k1_info, t_start)
+
+        # UNTIMED WARMUP CHILD (predeclared protocol step): the first
+        # process to compile the bfs_single program pays the remote
+        # compile + persistent-cache write INSIDE its timed window
+        # (measured 28.2 s vs 0.96 s warm for the same root); one
+        # throwaway child populates the cache so every TIMED root runs
+        # warm. Its stats are recorded as diagnostics, never in the
+        # statistic.
+        est = 240.0  # first-child guess: cold compile + upload + drain
+        if SEQ_ROOTS > 0 and remaining() > est:
+            t0 = time.perf_counter()
+            warm = run_child({"BENCH_SEQ_ROOT_IDX": "0"})
+            est = time.perf_counter() - t0
+            k1_info["seq_warmup_child"] = {
+                "mteps": warm.get("mteps"),
+                "warmup_s": warm.get("warmup_s"),
+                "wall_s": round(est, 1),
+            }
+            est = max(est * 0.7, 45.0)  # timed children run warm
+        for i in range(min(SEQ_ROOTS, NROOTS)):
+            if remaining() < est * 1.3 + 15:
+                break
+            t0 = time.perf_counter()
+            seq_runs.append(
+                run_child({
+                    "BENCH_SEQ_ROOT_IDX": str(i),
+                    "BENCH_SEQ_VALIDATE_THIS": "1" if i == 0 else "0",
+                })
+            )
+            est = time.perf_counter() - t0
+            emit(runs, seq_runs, construction_s, k1_info, t_start)
+        if not seq_runs:
+            # never leave the artifact without the final (identical) line
+            emit(runs, seq_runs, construction_s, k1_info, t_start)
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
-
-    ok = sorted(
-        (r for r in runs if r.get("mteps", 0) > 0), key=lambda r: r["mteps"]
-    )
-    # median REPEAT: value and the per-root statistic come from the same run
-    med_run = ok[(len(ok) - 1) // 2] if ok else {}
-    median = med_run.get("mteps", 0.0)
-    # Graph500-spec sequential statistic: harmonic mean of per-root TEPS
-    # over the individually-timed roots (each its own process)
-    seq_ok = [
-        r for r in seq_runs
-        if r.get("mteps", 0) > 0 and r.get("total_traversed_edges", 0) > 0
-    ]
-    seq_hm = (
-        len(seq_ok) / sum(1.0 / r["mteps"] for r in seq_ok) if seq_ok else 0.0
-    )
-    out = {
-        "metric": f"graph500_bfs_rmat_scale{SCALE}_1chip_MTEPS",
-        "value": round(median, 2),
-        "unit": "MTEPS",
-        "vs_baseline": round(median / BASELINE_MTEPS, 4),
-        "repeats_mteps": [r.get("mteps", 0.0) for r in runs],
-        "harmonic_mean_amortized_mteps": med_run.get(
-            "harmonic_mean_amortized_mteps", 0.0
-        ),
-        "seq_harmonic_mean_mteps": round(seq_hm, 3),
-        "seq_roots_timed": len(seq_ok),
-        "seq_per_root_mteps": [r.get("mteps", 0.0) for r in seq_runs],
-        "seq_vs_baseline": round(seq_hm / BASELINE_MTEPS, 6),
-        "construction_s": round(construction_s, 2),
-        "construction": k1_info,
-        "validation": med_run.get("validation"),
-        "validated": bool(
-            ok
-            and all(
-                r.get("validation") is not None
-                and not any(
-                    v for k, v in r["validation"].items() if k.endswith("_bad")
-                )
-                for r in ok
-            )
-        ),
-        "runs": runs,
-        "seq_runs": seq_runs,
-    }
-    if not ok:
-        out["error"] = (
-            "no repeat produced a valid measurement; see 'runs' for "
-            "per-child diagnostics"
-        )
-    if median < OPERATING_MTEPS / 2 and SCALE == 20 and NROOTS == 256:
-        out["warning"] = (
-            f"median {median:.1f} MTEPS >2x below operating point "
-            f"{OPERATING_MTEPS}; see per-run diagnostics in 'runs'"
-        )
-    print(json.dumps(out))
 
 
 if __name__ == "__main__":

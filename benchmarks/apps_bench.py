@@ -578,7 +578,7 @@ def bench_mcl_dense():
     compiled = jax.jit(run).lower(rows, cols, vals).compile()
     time.sleep(2)
     t0 = time.perf_counter()
-    m, it, ch, hist = compiled(rows, cols, vals)
+    m, it, ch, hist, npert = compiled(rows, cols, vals)
     iters = int(jax.device_get(it))  # the closing readback
     dt = time.perf_counter() - t0
     ch_v = float(jax.device_get(ch))
@@ -596,6 +596,7 @@ def bench_mcl_dense():
                 "chaos": round(ch_v, 6),
                 "chaos_trajectory": [round(float(x), 5) for x in hist_v],
                 "overflow": 0,
+                "perturbations": int(jax.device_get(npert)),
                 "select": SELECT,
                 "mode": MODE,
             }

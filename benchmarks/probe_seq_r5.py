@@ -1,0 +1,770 @@
+"""Round-5 probe: per-kernel cost of the bfs_single level kernels at
+scale 20 on the real chip.
+
+One MODE per process (the first timed readback poisons later launches):
+  MODE=dense   — the W-free int32 dense gather sweep
+  MODE=sparse  — the budgeted sparse column walk at PROBE_FCAP/PROBE_ECAP
+  MODE=cumsum  — just the frontier-compaction prefix ops
+  MODE=whole   — bfs_single end-to-end (levels readback only)
+
+Each kernel runs PROBE_REPS times inside ONE lax.fori_loop launch with a
+data dependency between iterations, so per-iteration cost = dt/REPS
+without per-launch dispatch noise.  Usage:
+  BENCH_GRAPH_NPZ=/tmp/g20.npz MODE=dense python benchmarks/probe_seq_r5.py
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+if os.environ.get("PROBE_NOCACHE") != "1":
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.path.join(os.path.dirname(__file__), "..", ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+
+from combblas_tpu.parallel.grid import Grid
+from combblas_tpu.ops.segment import expand_ranges
+
+MODE = os.environ.get("MODE", "dense")
+REPS = int(os.environ.get("PROBE_REPS", "10"))
+FCAP = int(os.environ.get("PROBE_FCAP", "131072"))
+ECAP = int(os.environ.get("PROBE_ECAP", "2097152"))
+FRONTIER = int(os.environ.get("PROBE_FRONTIER", "65536"))
+DRAIN = float(os.environ.get("PROBE_DRAIN_S", "10"))
+SCALE = int(os.environ.get("BENCH_SCALE", "20"))
+
+
+def main():
+    grid = Grid.make(1, 1)
+    n = 1 << SCALE
+    data = np.load(os.environ["BENCH_GRAPH_NPZ"])
+    from bench import _load_structures
+
+    E, csc = _load_structures(grid, data, n)
+    lr = grid.local_rows(n)
+    lc = grid.local_cols(n)
+    nb = len(E.buckets)
+    rng = np.random.default_rng(3)
+    fr = np.zeros(lc, np.int32) - 1
+    act_cols = rng.choice(lc, size=FRONTIER, replace=False)
+    fr[act_cols] = act_cols
+    x0 = jax.device_put(jnp.asarray(fr))  # [lc] frontier candidates
+    csc_indptr, csc_rowidx = csc
+
+    buckets = [tuple(a[0, 0] for a in b) for b in E.buckets]
+    indptr = csc_indptr[0, 0]
+    rowid = csc_rowidx[0, 0]
+
+    def dense_step(x):
+        xpad = jnp.concatenate([x, jnp.full((1,), -1, jnp.int32)])
+        y = jnp.full((lr,), -1, jnp.int32)
+        for bc, _bv, br in buckets:
+            g = xpad[jnp.minimum(bc, lc)]
+            yb = jnp.max(g, axis=1)
+            y = y.at[br].max(yb, mode="drop")
+        return y
+
+    def compact(x):
+        act = x >= 0
+        pos = jnp.cumsum(act.astype(jnp.int32)) - 1
+        scatter = jnp.where(act, pos, FCAP)
+        fcols = (
+            jnp.full((FCAP,), lc, jnp.int32)
+            .at[scatter]
+            .set(jnp.arange(lc, dtype=jnp.int32), mode="drop")
+        )
+        return fcols
+
+    def sparse_step(x):
+        fcols = compact(x)
+        ipt_pad = jnp.concatenate([indptr, indptr[-1:]])
+        deg = jnp.where(fcols < lc, ipt_pad[fcols + 1] - ipt_pad[fcols], 0)
+        owner, offset, valid, _ = expand_ranges(deg, ECAP)
+        src_col = fcols[owner]
+        slot = jnp.minimum(
+            ipt_pad[jnp.minimum(src_col, lc)] + offset, rowid.shape[0] - 1
+        )
+        tgt_row = jnp.where(valid, rowid[slot], lr)
+        xpad = jnp.concatenate([x, jnp.full((1,), -1, jnp.int32)])
+        contrib = jnp.where(valid, xpad[jnp.minimum(src_col, lc)], -1)
+        y = jnp.full((lr,), -1, jnp.int32).at[tgt_row].max(
+            contrib, mode="drop"
+        )
+        return y
+
+    def cumsum_only(x):
+        return jnp.cumsum((x >= 0).astype(jnp.int32)) - 1
+
+    def scatter_only(x):
+        act = x >= 0
+        pos = jnp.arange(lc, dtype=jnp.int32)  # fake positions, no cumsum
+        scatter = jnp.where(act, pos, FCAP)
+        return (
+            jnp.full((FCAP,), lc, jnp.int32)
+            .at[jnp.minimum(scatter, FCAP)]
+            .set(jnp.arange(lc, dtype=jnp.int32), mode="drop")
+        )
+
+    def stats_only(x):
+        act = x >= 0
+        coldeg = indptr[1:] - indptr[:-1]
+        return (jnp.sum(act.astype(jnp.int32))
+                + jnp.sum(jnp.where(act, coldeg, 0)))[None]
+
+    if MODE in ("dense", "sparse", "cumsum", "cumsumonly", "scatteronly",
+                "stats"):
+        fn = {"dense": dense_step, "sparse": sparse_step,
+              "cumsum": compact, "cumsumonly": cumsum_only,
+              "scatteronly": scatter_only, "stats": stats_only}[MODE]
+
+        @jax.jit
+        def reps(x):
+            # anti-DCE dependency: the next iteration's frontier depends
+            # on min(y) via a predicate XLA cannot prove false (y values
+            # are >= -1 by construction, but that's runtime knowledge),
+            # so every rep's full kernel must execute; at runtime x is
+            # unchanged, keeping the access pattern identical per rep.
+            def body(i, x):
+                y = fn(x)
+                return jnp.where(jnp.min(y) == -5, x * 0 + i, x)
+
+            return jax.lax.fori_loop(0, REPS, body, x)
+
+        out = reps(x0)
+        jax.block_until_ready(out)
+        time.sleep(DRAIN)
+        t0 = time.perf_counter()
+        out = reps(x0)
+        v = int(np.asarray(jax.device_get(out))[0])
+        dt = time.perf_counter() - t0
+        print(json.dumps({
+            "mode": MODE, "reps": REPS, "dt_s": round(dt, 3),
+            "s_per_step": round(dt / REPS, 4), "sink": v,
+            "fcap": FCAP, "ecap": ECAP, "frontier": FRONTIER,
+        }), flush=True)
+    elif MODE in ("v1", "v2", "v3"):
+        # ablation ladder for the in-loop overhead: v1 = shard_map'd
+        # dense level in a 6-iteration loop; v2 = + DistVec realign;
+        # v3 = + parents/levels updates and the any(new) cond (i.e.
+        # bfs_single minus stats+switch).
+        from jax.sharding import PartitionSpec as P
+        from combblas_tpu.parallel.grid import COL_AXIS, ROW_AXIS
+        from combblas_tpu.parallel.spmat import TILE_SPEC
+        from combblas_tpu.parallel.vec import DistVec
+
+        flat_args = [a for b in E.buckets for a in b]
+        row_gids = jnp.arange(lr, dtype=jnp.int32)[None]
+
+        def dense_level_sm(x, undisc):
+            def body(xblk, ublk, *flat):
+                bks = [
+                    tuple(a[0, 0] for a in flat[3 * i : 3 * i + 3])
+                    for i in range(nb)
+                ]
+                xv = xblk[0]
+                xpad = jnp.concatenate([xv, jnp.full((1,), -1, jnp.int32)])
+                y = jnp.full((lr,), -1, jnp.int32)
+                for bc, _bv, br in bks:
+                    g = xpad[jnp.minimum(bc, lc)]
+                    y = y.at[br].max(jnp.max(g, axis=1), mode="drop")
+                y = jnp.where(ublk[0], y, -1)
+                return jax.lax.pmax(y, COL_AXIS)[None]
+
+            return jax.shard_map(
+                body, mesh=grid.mesh,
+                in_specs=(P(COL_AXIS), P(ROW_AXIS)) + (TILE_SPEC,) * (3 * nb),
+                out_specs=P(ROW_AXIS), check_vma=False,
+            )(x, undisc, *flat_args)
+
+        root = np.int32(data["roots"][0])
+        x_init = jnp.where(row_gids == root, jnp.int32(root), -1)
+
+        @jax.jit
+        def run(x0):
+            if MODE == "v1":
+                def body(i, x):
+                    y = dense_level_sm(x, x == x)  # undisc all-true
+                    return jnp.where(y >= 0, row_gids, -1)
+
+                return jax.lax.fori_loop(0, 6, body, x0)
+            if MODE == "v2":
+                def body(i, x):
+                    y = dense_level_sm(x, x == x)
+                    fr = DistVec(
+                        blocks=jnp.where(y >= 0, row_gids, -1),
+                        length=n, align="row", grid=grid,
+                    )
+                    return fr.realign("col").blocks
+
+                return jax.lax.fori_loop(0, 6, body, x0)
+            # v3: full step minus stats+switch
+            parents0 = jnp.where(row_gids == root, jnp.int32(root), -1)
+            levels0 = jnp.where(row_gids == root, 0, -1).astype(jnp.int32)
+
+            def cond(st):
+                return st[3] & (st[2] < 6)
+
+            def body(st):
+                parents, levels, level, _, x = st
+                undisc = parents < 0
+                y = dense_level_sm(x, undisc)
+                new = (y >= 0) & undisc
+                parents = jnp.where(new, y, parents)
+                levels = jnp.where(new, level + 1, levels)
+                fr = DistVec(
+                    blocks=jnp.where(new, row_gids, -1),
+                    length=n, align="row", grid=grid,
+                )
+                return (parents, levels, level + 1, jnp.any(new),
+                        fr.realign("col").blocks)
+
+            st = jax.lax.while_loop(
+                cond, body,
+                (parents0, levels0, jnp.int32(0), jnp.bool_(True), x0),
+            )
+            return st[0]
+
+        out = run(x_init)
+        jax.block_until_ready(out)
+        time.sleep(DRAIN)
+        t0 = time.perf_counter()
+        out = run(x_init)
+        v = int(np.asarray(jax.device_get(out))[0, 0])
+        dt = time.perf_counter() - t0
+        print(json.dumps({
+            "mode": MODE, "dt_s": round(dt, 3),
+            "s_per_level": round(dt / 6, 3), "sink": v,
+        }), flush=True)
+    elif MODE in ("v4", "v5", "v6"):
+        # continue the bisection from v3 toward bfs_single:
+        # v4 = v3 + traced source + col_gids-style x0 init
+        # v5 = v4 + while bound n (instead of 6) + niter carried
+        # v6 = v5 + coldeg shard_map before the loop (csc operands live)
+        from jax.sharding import PartitionSpec as P
+        from combblas_tpu.parallel.grid import COL_AXIS, ROW_AXIS
+        from combblas_tpu.parallel.spmat import TILE_SPEC
+        from combblas_tpu.parallel.vec import DistVec
+
+        flat_args = [a for b in E.buckets for a in b]
+        row_gids = jnp.arange(lr, dtype=jnp.int32)[None]
+        col_gids = jnp.arange(lc, dtype=jnp.int32)[None]
+
+        def dense_level_sm(x, undisc):
+            def body(xblk, ublk, *flat):
+                bks = [
+                    tuple(a[0, 0] for a in flat[3 * i : 3 * i + 3])
+                    for i in range(nb)
+                ]
+                xv = xblk[0]
+                xpad = jnp.concatenate([xv, jnp.full((1,), -1, jnp.int32)])
+                y = jnp.full((lr,), -1, jnp.int32)
+                for bc, _bv, br in bks:
+                    g = xpad[jnp.minimum(bc, lc)]
+                    y = y.at[br].max(jnp.max(g, axis=1), mode="drop")
+                y = jnp.where(ublk[0], y, -1)
+                return jax.lax.pmax(y, COL_AXIS)[None]
+
+            return jax.shard_map(
+                body, mesh=grid.mesh,
+                in_specs=(P(COL_AXIS), P(ROW_AXIS)) + (TILE_SPEC,) * (3 * nb),
+                out_specs=P(ROW_AXIS), check_vma=False,
+            )(x, undisc, *flat_args)
+
+        bound = 6 if MODE == "v4" else n
+
+        @jax.jit
+        def run(source):
+            parents0 = jnp.where(row_gids == source, source, -1)
+            levels0 = jnp.where(row_gids == source, 0, -1).astype(jnp.int32)
+            x0 = jnp.where(col_gids == source, source, -1)
+            if MODE == "v6":
+                def colde_body(ipt):
+                    d = ipt[0, 0][1:] - ipt[0, 0][:-1]
+                    return jax.lax.psum(d, ROW_AXIS)[None]
+
+                coldeg = jax.shard_map(
+                    colde_body, mesh=grid.mesh,
+                    in_specs=(P(ROW_AXIS, COL_AXIS),),
+                    out_specs=P(COL_AXIS), check_vma=False,
+                )(csc_indptr)
+                parents0 = parents0 + jnp.min(coldeg) * 0
+
+            def cond(st):
+                return st[3] & (st[2] < bound)
+
+            def body(st):
+                parents, levels, level, _, x = st
+                undisc = parents < 0
+                y = dense_level_sm(x, undisc)
+                new = (y >= 0) & undisc
+                parents = jnp.where(new, y, parents)
+                levels = jnp.where(new, level + 1, levels)
+                fr = DistVec(
+                    blocks=jnp.where(new, row_gids, -1),
+                    length=n, align="row", grid=grid,
+                )
+                return (parents, levels, level + 1, jnp.any(new),
+                        fr.realign("col").blocks)
+
+            st = jax.lax.while_loop(
+                cond, body,
+                (parents0, levels0, jnp.int32(0), jnp.bool_(True), x0),
+            )
+            return st[0], st[1], st[2]
+
+        src = np.int32(data["roots"][0])
+        out = run(src)
+        jax.block_until_ready(out[0])
+        time.sleep(DRAIN)
+        t0 = time.perf_counter()
+        out = run(src)
+        it = int(np.asarray(jax.device_get(out[2])))
+        dt = time.perf_counter() - t0
+        print(json.dumps({
+            "mode": MODE, "dt_s": round(dt, 3), "levels": it,
+            "s_per_level": round(dt / max(it, 1), 3),
+        }), flush=True)
+    elif MODE == "v7":
+        # v7 = v5 (fast closure version) but with every bucket array
+        # passed as a JIT ARGUMENT (the way bfs_single receives E) —
+        # isolates operand-passing vs closure-constant embedding.
+        from jax.sharding import PartitionSpec as P
+        from combblas_tpu.parallel.grid import COL_AXIS, ROW_AXIS
+        from combblas_tpu.parallel.spmat import TILE_SPEC
+        from combblas_tpu.parallel.vec import DistVec
+
+        flat_args = [a for b in E.buckets for a in b]
+        row_gids = jnp.arange(lr, dtype=jnp.int32)[None]
+        col_gids = jnp.arange(lc, dtype=jnp.int32)[None]
+
+        @jax.jit
+        def run(source, *fa):
+            def dense_level_sm(x, undisc):
+                def body(xblk, ublk, *flat):
+                    bks = [
+                        tuple(a[0, 0] for a in flat[3 * i : 3 * i + 3])
+                        for i in range(nb)
+                    ]
+                    xv = xblk[0]
+                    xpad = jnp.concatenate(
+                        [xv, jnp.full((1,), -1, jnp.int32)]
+                    )
+                    y = jnp.full((lr,), -1, jnp.int32)
+                    for bc, _bv, br in bks:
+                        g = xpad[jnp.minimum(bc, lc)]
+                        y = y.at[br].max(jnp.max(g, axis=1), mode="drop")
+                    y = jnp.where(ublk[0], y, -1)
+                    return jax.lax.pmax(y, COL_AXIS)[None]
+
+                return jax.shard_map(
+                    body, mesh=grid.mesh,
+                    in_specs=(P(COL_AXIS), P(ROW_AXIS))
+                    + (TILE_SPEC,) * (3 * nb),
+                    out_specs=P(ROW_AXIS), check_vma=False,
+                )(x, undisc, *fa)
+
+            parents0 = jnp.where(row_gids == source, source, -1)
+            levels0 = jnp.where(row_gids == source, 0, -1).astype(jnp.int32)
+            x0 = jnp.where(col_gids == source, source, -1)
+
+            def cond(st):
+                return st[3] & (st[2] < n)
+
+            def body(st):
+                parents, levels, level, _, x = st
+                undisc = parents < 0
+                y = dense_level_sm(x, undisc)
+                new = (y >= 0) & undisc
+                parents = jnp.where(new, y, parents)
+                levels = jnp.where(new, level + 1, levels)
+                fr = DistVec(
+                    blocks=jnp.where(new, row_gids, -1),
+                    length=n, align="row", grid=grid,
+                )
+                return (parents, levels, level + 1, jnp.any(new),
+                        fr.realign("col").blocks)
+
+            st = jax.lax.while_loop(
+                cond, body,
+                (parents0, levels0, jnp.int32(0), jnp.bool_(True), x0),
+            )
+            return st[0], st[2]
+
+        src = np.int32(data["roots"][0])
+        out = run(src, *flat_args)
+        jax.block_until_ready(out[0])
+        time.sleep(DRAIN)
+        t0 = time.perf_counter()
+        out = run(src, *flat_args)
+        it = int(np.asarray(jax.device_get(out[1])))
+        dt = time.perf_counter() - t0
+        print(json.dumps({
+            "mode": MODE, "dt_s": round(dt, 3), "levels": it,
+        }), flush=True)
+    elif MODE in ("aot", "nocsc"):
+        from combblas_tpu.models.bfs import bfs_single
+        from combblas_tpu.parallel.vec import DistVec
+        import functools
+
+        root = np.int32(data["roots"][0])
+        cdg = DistVec.from_global(grid, data["deg"], align="col").blocks
+        if MODE == "nocsc":
+            dummy = (jnp.zeros((1, 1, 2), jnp.int32),
+                     jnp.zeros((1, 1, 2), jnp.int32))
+            args = (E, root, dummy)
+        else:
+            args = (E, root, csc)
+        fn = functools.partial(bfs_single, tiers=(), coldeg=cdg)
+        if MODE == "aot":
+            compiled = jax.jit(fn).lower(*args).compile()
+            call = lambda: compiled(*args)
+        else:
+            call = lambda: fn(*args)
+        p, l, niter = call()
+        jax.block_until_ready(p.blocks)
+        time.sleep(DRAIN)
+        t0 = time.perf_counter()
+        p, l, niter = call()
+        it = int(np.asarray(jax.device_get(niter)))
+        dt = time.perf_counter() - t0
+        print(json.dumps({"mode": MODE, "dt_s": round(dt, 3),
+                          "levels": it}), flush=True)
+    elif MODE in ("w1", "w2", "w3"):
+        # morph fast-v7 toward bfs_single:
+        # w1 = v7 + levels carry/output + DistVec-wrapped outputs
+        # w2 = w1 + unused operands (csc, csr, coldeg, rowdeg, iota)
+        # w3 = w2 + gids as NamedSharding operands (bfs_single's
+        #      _gid_blocks) instead of plain closure arrays
+        from jax.sharding import PartitionSpec as P
+        from combblas_tpu.parallel.grid import COL_AXIS, ROW_AXIS
+        from combblas_tpu.parallel.spmat import TILE_SPEC
+        from combblas_tpu.parallel.vec import DistVec
+        from combblas_tpu.models.bfs import _gid_blocks, _iota_operand
+
+        flat_args = [a for b in E.buckets for a in b]
+        if MODE == "w3":
+            row_gids = _gid_blocks(grid, 1, lr, n, "row")
+            col_gids = _gid_blocks(grid, 1, lc, n, "col")
+        else:
+            row_gids = jnp.arange(lr, dtype=jnp.int32)[None]
+            col_gids = jnp.arange(lc, dtype=jnp.int32)[None]
+        cdg = DistVec.from_global(grid, data["deg"], align="col").blocks
+        rdg = DistVec.from_global(grid, data["deg"], align="row").blocks
+        iota = _iota_operand(131072)
+
+        def mkfn(with_unused):
+            def run(source, row_gids_, col_gids_, cdg_, rdg_, iota_,
+                    ipt, ridx, ipt2, ridx2, *fa):
+                def dense_level_sm(x, undisc):
+                    def body(xblk, ublk, *flat):
+                        bks = [tuple(a[0, 0] for a in flat[3*i:3*i+3])
+                               for i in range(nb)]
+                        xv = xblk[0]
+                        xpad = jnp.concatenate(
+                            [xv, jnp.full((1,), -1, jnp.int32)])
+                        y = jnp.full((lr,), -1, jnp.int32)
+                        for bc, _bv, br in bks:
+                            g = xpad[jnp.minimum(bc, lc)]
+                            y = y.at[br].max(jnp.max(g, axis=1),
+                                             mode="drop")
+                        y = jnp.where(ublk[0], y, -1)
+                        return jax.lax.pmax(y, COL_AXIS)[None]
+                    return jax.shard_map(body, mesh=grid.mesh,
+                        in_specs=(P(COL_AXIS), P(ROW_AXIS))
+                        + (TILE_SPEC,) * (3 * nb),
+                        out_specs=P(ROW_AXIS), check_vma=False,
+                    )(x, undisc, *fa)
+                parents0 = jnp.where(row_gids_ == source, source, -1)
+                levels0 = jnp.where(
+                    row_gids_ == source, 0, -1).astype(jnp.int32)
+                x0 = jnp.where(col_gids_ == source, source, -1)
+                def cond(st):
+                    return st[4] & (st[3] < n)
+                def body(st):
+                    parents, levels, x, level, _ = st
+                    undisc = parents < 0
+                    y = dense_level_sm(x, undisc)
+                    new = (y >= 0) & undisc & (row_gids_ >= 0)
+                    parents = jnp.where(new, y, parents)
+                    levels = jnp.where(new, level + 1, levels)
+                    fr = DistVec(
+                        blocks=jnp.where(new, row_gids_, -1), length=n,
+                        align="row", grid=grid)
+                    return (parents, levels, fr.realign("col").blocks,
+                            level + 1, jnp.any(new))
+                st = jax.lax.while_loop(cond, body,
+                    (parents0, levels0, x0, jnp.int32(0),
+                     jnp.bool_(True)))
+                mk = lambda b: DistVec(blocks=b, length=n, align="row",
+                                       grid=grid)
+                return mk(st[0]), mk(st[1]), st[3]
+            return run
+
+        run = jax.jit(mkfn(MODE != "w1"))
+        args = (np.int32(data["roots"][0]), row_gids, col_gids, cdg,
+                rdg, iota, csc_indptr, csc_rowidx, csc_indptr,
+                csc_rowidx, *flat_args)
+        p, l, niter = run(*args)
+        jax.block_until_ready(p.blocks)
+        time.sleep(DRAIN)
+        t0 = time.perf_counter()
+        p, l, niter = run(*args)
+        it = int(np.asarray(jax.device_get(niter)))
+        dt = time.perf_counter() - t0
+        print(json.dumps({"mode": MODE, "dt_s": round(dt, 3),
+                          "levels": it}), flush=True)
+    elif MODE in ("wa", "wb", "wc"):
+        # v3-style fast loop + ONE bfs_single feature each:
+        # wa = + (parents, levels, niter) multi-output (plain arrays)
+        # wb = + "& (row_gids >= 0)" term in `new`
+        # wc = + DistVec-wrapped outputs
+        from jax.sharding import PartitionSpec as P
+        from combblas_tpu.parallel.grid import COL_AXIS, ROW_AXIS
+        from combblas_tpu.parallel.spmat import TILE_SPEC
+        from combblas_tpu.parallel.vec import DistVec
+
+        flat_args = [a for b in E.buckets for a in b]
+        row_gids = jnp.arange(lr, dtype=jnp.int32)[None]
+
+        def dense_level_sm(x, undisc):
+            def body(xblk, ublk, *flat):
+                bks = [tuple(a[0, 0] for a in flat[3*i:3*i+3])
+                       for i in range(nb)]
+                xv = xblk[0]
+                xpad = jnp.concatenate(
+                    [xv, jnp.full((1,), -1, jnp.int32)])
+                y = jnp.full((lr,), -1, jnp.int32)
+                for bc, _bv, br in bks:
+                    g = xpad[jnp.minimum(bc, lc)]
+                    y = y.at[br].max(jnp.max(g, axis=1), mode="drop")
+                y = jnp.where(ublk[0], y, -1)
+                return jax.lax.pmax(y, COL_AXIS)[None]
+            return jax.shard_map(body, mesh=grid.mesh,
+                in_specs=(P(COL_AXIS), P(ROW_AXIS))
+                + (TILE_SPEC,) * (3 * nb),
+                out_specs=P(ROW_AXIS), check_vma=False,
+            )(x, undisc, *flat_args)
+
+        root = np.int32(data["roots"][0])
+        x_init = jnp.where(row_gids == root, jnp.int32(root), -1)
+
+        @jax.jit
+        def run(x0):
+            parents0 = jnp.where(row_gids == root, jnp.int32(root), -1)
+            levels0 = jnp.where(row_gids == root, 0, -1).astype(jnp.int32)
+            def cond(st):
+                return st[3] & (st[2] < 6)
+            def body(st):
+                parents, levels, level, _, x = st
+                undisc = parents < 0
+                y = dense_level_sm(x, undisc)
+                if MODE == "wb":
+                    new = (y >= 0) & undisc & (row_gids >= 0)
+                else:
+                    new = (y >= 0) & undisc
+                parents = jnp.where(new, y, parents)
+                levels = jnp.where(new, level + 1, levels)
+                fr = DistVec(
+                    blocks=jnp.where(new, row_gids, -1), length=n,
+                    align="row", grid=grid)
+                return (parents, levels, level + 1, jnp.any(new),
+                        fr.realign("col").blocks)
+            st = jax.lax.while_loop(cond, body,
+                (parents0, levels0, jnp.int32(0), jnp.bool_(True), x_init))
+            if MODE == "wa":
+                return st[0], st[1], st[2]
+            if MODE == "wc":
+                mk = lambda b: DistVec(blocks=b, length=n, align="row",
+                                       grid=grid)
+                return mk(st[0]), mk(st[1]), st[2]
+            return st[0], st[2]
+
+        out = run(x_init)
+        first = out[0].blocks if MODE == "wc" else out[0]
+        jax.block_until_ready(first)
+        time.sleep(DRAIN)
+        t0 = time.perf_counter()
+        out = run(x_init)
+        sink = out[-1] if MODE != "wb" else out[0]
+        v = np.asarray(jax.device_get(sink))
+        dt = time.perf_counter() - t0
+        print(json.dumps({"mode": MODE, "dt_s": round(dt, 3)}),
+              flush=True)
+    elif MODE in ("w4", "w5", "w6", "w7"):
+        # w4 = v7(args, plain outputs) + all of bfs_single's extra
+        #      operands passed (csc x2, csr x2, cdg, rdg, iota) UNUSED
+        # w5 = w4 minus the two huge flat companions (csc/csr idx)
+        # w6 = v7 exactly, re-measured now (chip-state control)
+        from jax.sharding import PartitionSpec as P
+        from combblas_tpu.parallel.grid import COL_AXIS, ROW_AXIS
+        from combblas_tpu.parallel.spmat import TILE_SPEC
+        from combblas_tpu.parallel.vec import DistVec
+        from combblas_tpu.models.bfs import _iota_operand
+
+        flat_args = [a for b in E.buckets for a in b]
+        row_gids = jnp.arange(lr, dtype=jnp.int32)[None]
+        col_gids = jnp.arange(lc, dtype=jnp.int32)[None]
+        cdg = DistVec.from_global(grid, data["deg"], align="col").blocks
+        rdg = DistVec.from_global(grid, data["deg"], align="row").blocks
+        iota = _iota_operand(131072)
+
+        def run(source, *ops):
+            fa = ops[: 3 * nb]
+
+            def dense_level_sm(x, undisc):
+                def body(xblk, ublk, *flat):
+                    bks = [tuple(a[0, 0] for a in flat[3*i:3*i+3])
+                           for i in range(nb)]
+                    xv = xblk[0]
+                    xpad = jnp.concatenate(
+                        [xv, jnp.full((1,), -1, jnp.int32)])
+                    y = jnp.full((lr,), -1, jnp.int32)
+                    for bc, _bv, br in bks:
+                        g = xpad[jnp.minimum(bc, lc)]
+                        y = y.at[br].max(jnp.max(g, axis=1), mode="drop")
+                    y = jnp.where(ublk[0], y, -1)
+                    return jax.lax.pmax(y, COL_AXIS)[None]
+                return jax.shard_map(body, mesh=grid.mesh,
+                    in_specs=(P(COL_AXIS), P(ROW_AXIS))
+                    + (TILE_SPEC,) * (3 * nb),
+                    out_specs=P(ROW_AXIS), check_vma=False,
+                )(x, undisc, *fa)
+            parents0 = jnp.where(row_gids == source, source, -1)
+            levels0 = jnp.where(
+                row_gids == source, 0, -1).astype(jnp.int32)
+            x0 = jnp.where(col_gids == source, source, -1)
+            def cond(st):
+                return st[3] & (st[2] < n)
+            def body(st):
+                parents, levels, level, _, x = st
+                undisc = parents < 0
+                y = dense_level_sm(x, undisc)
+                new = (y >= 0) & undisc
+                parents = jnp.where(new, y, parents)
+                levels = jnp.where(new, level + 1, levels)
+                fr = DistVec(
+                    blocks=jnp.where(new, row_gids, -1), length=n,
+                    align="row", grid=grid)
+                return (parents, levels, level + 1, jnp.any(new),
+                        fr.realign("col").blocks)
+            st = jax.lax.while_loop(cond, body,
+                (parents0, levels0, jnp.int32(0), jnp.bool_(True), x0))
+            return st[0], st[1], st[2]
+
+        if MODE == "w7":
+            # gids as plain jit ARGUMENTS instead of closures
+            def run7(source, rg, cg, *ops):
+                fa = ops[: 3 * nb]
+                def dense_level_sm(x, undisc):
+                    def body(xblk, ublk, *flat):
+                        bks = [tuple(a[0, 0] for a in flat[3*i:3*i+3])
+                               for i in range(nb)]
+                        xv = xblk[0]
+                        xpad = jnp.concatenate(
+                            [xv, jnp.full((1,), -1, jnp.int32)])
+                        y = jnp.full((lr,), -1, jnp.int32)
+                        for bc, _bv, br in bks:
+                            g = xpad[jnp.minimum(bc, lc)]
+                            y = y.at[br].max(jnp.max(g, axis=1),
+                                             mode="drop")
+                        y = jnp.where(ublk[0], y, -1)
+                        return jax.lax.pmax(y, COL_AXIS)[None]
+                    return jax.shard_map(body, mesh=grid.mesh,
+                        in_specs=(P(COL_AXIS), P(ROW_AXIS))
+                        + (TILE_SPEC,) * (3 * nb),
+                        out_specs=P(ROW_AXIS), check_vma=False,
+                    )(x, undisc, *fa)
+                parents0 = jnp.where(rg == source, source, -1)
+                levels0 = jnp.where(rg == source, 0, -1).astype(jnp.int32)
+                x0 = jnp.where(cg == source, source, -1)
+                def cond(st):
+                    return st[3] & (st[2] < n)
+                def body(st):
+                    parents, levels, level, _, x = st
+                    undisc = parents < 0
+                    y = dense_level_sm(x, undisc)
+                    new = (y >= 0) & undisc
+                    parents = jnp.where(new, y, parents)
+                    levels = jnp.where(new, level + 1, levels)
+                    fr = DistVec(
+                        blocks=jnp.where(new, rg, -1), length=n,
+                        align="row", grid=grid)
+                    return (parents, levels, level + 1, jnp.any(new),
+                            fr.realign("col").blocks)
+                st = jax.lax.while_loop(cond, body,
+                    (parents0, levels0, jnp.int32(0), jnp.bool_(True),
+                     x0))
+                return st[0], st[1], st[2]
+            jrun = jax.jit(run7)
+            args = (np.int32(data["roots"][0]),
+                    jax.device_put(row_gids), jax.device_put(col_gids),
+                    *flat_args)
+            out = jrun(*args)
+            jax.block_until_ready(out[0])
+            time.sleep(DRAIN)
+            t0 = time.perf_counter()
+            out = jrun(*args)
+            it = int(np.asarray(jax.device_get(out[2])))
+            dt = time.perf_counter() - t0
+            print(json.dumps({"mode": MODE, "dt_s": round(dt, 3),
+                              "levels": it}), flush=True)
+            return
+        extra = ()
+        if MODE == "w4":
+            extra = (csc_indptr, csc_rowidx, csc_indptr, csc_rowidx,
+                     cdg, rdg, iota)
+        elif MODE == "w5":
+            extra = (csc_indptr, csc_indptr, cdg, rdg, iota)
+        args = (np.int32(data["roots"][0]), *flat_args, *extra)
+        jrun = jax.jit(run)
+        out = jrun(*args)
+        jax.block_until_ready(out[0])
+        time.sleep(DRAIN)
+        t0 = time.perf_counter()
+        out = jrun(*args)
+        it = int(np.asarray(jax.device_get(out[2])))
+        dt = time.perf_counter() - t0
+        print(json.dumps({"mode": MODE, "dt_s": round(dt, 3),
+                          "levels": it}), flush=True)
+    elif MODE == "whole":
+        from combblas_tpu.models.bfs import bfs_single
+        from combblas_tpu.parallel.vec import DistVec
+
+        from combblas_tpu.models.bfs import parse_tier_spec
+
+        spec = os.environ.get(
+            "BENCH_SEQ_TIERS",
+            "td:1024,1024,512,128,16,2"
+            "|bu:524288,16384,1024,0,0,0"
+            "|bu:1048576,32768,2048,128,0,0",
+        )
+        tiers = parse_tier_spec(spec)
+        root = np.int32(data["roots"][int(os.environ.get("ROOT", "0"))])
+        cdg = DistVec.from_global(grid, data["deg"], align="col").blocks
+        rdg = DistVec.from_global(grid, data["deg"], align="row").blocks
+        p, l, niter = bfs_single(E, root, csc, csr=csc, tiers=tiers,
+                                 coldeg=cdg, rowdeg=rdg)
+        jax.block_until_ready(p.blocks)
+        time.sleep(DRAIN)
+        t0 = time.perf_counter()
+        p, l, niter = bfs_single(E, root, csc, csr=csc, tiers=tiers,
+                                 coldeg=cdg, rowdeg=rdg)
+        it = int(np.asarray(jax.device_get(niter)))
+        dt = time.perf_counter() - t0
+        print(json.dumps({
+            "mode": MODE, "dt_s": round(dt, 3), "levels": it,
+            "tiers": list(tiers),
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -21,7 +21,7 @@ The sparse-frontier SpMSpV path still exists (``parallel/spmv.py`` +
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 
 import jax
 import jax.numpy as jnp
@@ -496,6 +496,414 @@ def bfs_batch(
         # sources / discovered? -1 undiscovered) — parents' sign carries it.
         levels = jnp.where(parents >= 0, 0, -1)
     return mk(parents, "row"), mk(levels, "row"), niter
+
+
+@lru_cache(maxsize=None)
+def _gid_blocks(grid, nblocks: int, block_len: int, length: int,
+                align: str):
+    """Materialized global-id blocks (``_global_ids`` as a DEVICE BUFFER,
+    built host-side and uploaded once per (grid, shape)).
+
+    Why not jnp.arange inside the jitted program: on the target backend
+    an iota-derived gid table fuses into the while-loop body as a
+    per-iteration rematerialization that executes SERIALLY — the
+    otherwise-identical single-root BFS program measured 39.5 s with the
+    in-program iota vs 1.7 s with the table passed as an operand
+    (benchmarks/probe_seq_r5.py, modes v9 vs v7)."""
+    import numpy as np
+
+    g = np.arange(nblocks * block_len, dtype=np.int32).reshape(
+        nblocks, block_len
+    )
+    g = np.where(g < length, g, -1)
+    if grid.size == 1:
+        # UNSHARDED on purpose: a NamedSharding'd vector operand makes
+        # the whole compiled program execute ~25x slower on the target
+        # backend (probe_seq_r5 w3 47.3 s vs v7 1.7 s — same loop, only
+        # the gid operands' sharding differs)
+        return jax.device_put(jnp.asarray(g))
+    sh = (
+        grid.row_aligned_sharding() if align == "row"
+        else grid.col_aligned_sharding()
+    )
+    return jax.device_put(jnp.asarray(g), sh)
+
+
+#: Global degree-class ladder shared by every bfs_single tier: class c
+#: holds vertices with degree in (LADDER[c-1], LADDER[c]]; degrees past
+#: the last rung only ever run the dense sweep.
+BFS_CLASS_LADDER = (8, 64, 512, 4096, 32768, 131072)
+
+
+@lru_cache(maxsize=None)
+def _iota_operand(kmax: int):
+    """[kmax] iota as a materialized device buffer — in-program iotas
+    serialize inside while-loop fusions on the target backend (the v9
+    pathology, see _gid_blocks)."""
+    import numpy as np
+
+    return jax.device_put(jnp.asarray(np.arange(kmax, dtype=np.int32)))
+
+
+def bfs_single(E, source, csc, *, tiers, csr=None, coldeg=None,
+               rowdeg=None, max_iters: int | None = None):
+    """Frontier/undiscovered-proportional single-root BFS — see
+    ``_bfs_single_program`` for the design. This wrapper resolves the
+    cached program for (grid, shape, tiers) and fills test-path
+    fallbacks: ``csr`` (per-tile row-major companion,
+    ``ellmat.build_csr_companion`` — required for "bu" tiers),
+    ``coldeg``/``rowdeg`` (global degree vectors as [pc, lc] / [pr, lr]
+    blocks; pass precomputed blocks on the real chip).
+
+    Returns (parents DistVec i32, levels DistVec i32, num_iters).
+    """
+    from ..semiring import PLUS_TIMES
+    from ..parallel.spmat import ones_i32
+
+    grid = E.grid
+    if any(kind == "bu" for kind, _ in tiers) and csr is None:
+        raise ValueError(
+            "bu tiers need the row-major companion: "
+            "csr=build_csr_companion(grid, rows, cols, nrows, ncols)"
+        )
+    if rowdeg is None:
+        rowdeg = E.reduce(PLUS_TIMES, "cols", map_fn=ones_i32).blocks
+    if coldeg is None:
+        # test fallback; chip callers pass host-built blocks (the CSC
+        # indptr derivation is the probe-v6 megascale-1-D pathology)
+        rd = DistVec(
+            blocks=rowdeg, length=E.nrows, align="row", grid=grid
+        )
+        coldeg = rd.realign("col").blocks
+    if csr is None:
+        csr = csc  # placeholder operand; no "bu" tier traces it
+    run = _bfs_single_program(
+        grid, E.nrows, E.ncols, len(E.buckets), tiers, max_iters
+    )
+    flat = [a for b in E.buckets for a in b]
+    parents, levels, niter = run(
+        jnp.int32(source), csc[0], csc[1], csr[0], csr[1], coldeg,
+        rowdeg, *flat,
+    )
+    mk = lambda b: DistVec(blocks=b, length=E.nrows, align="row",
+                           grid=grid)
+    return mk(parents), mk(levels), niter
+
+
+def parse_tier_spec(spec: str):
+    """``"td:1024,1024,512,128,16,2|bu:524288,16384,1024,0,0,0"`` →
+    bfs_single tier tuple. Empty string → () (always-dense)."""
+    tiers = []
+    for part in spec.split("|"):
+        if not part:
+            continue
+        kind, _, budg = part.partition(":")
+        budgets = tuple(int(v) for v in budg.split(","))
+        assert kind in ("td", "bu") and len(budgets) == len(
+            BFS_CLASS_LADDER
+        ), part
+        tiers.append((kind, budgets))
+    return tuple(tiers)
+
+
+@lru_cache(maxsize=32)
+def _bfs_single_program(grid, nrows, ncols, nbuckets, tiers,
+                        max_iters: int | None = None):
+    """Single-root BFS whose per-level cost follows the DIRECTION-OPTIMIZED
+    work profile, not nnz — the Graph500 spec's SEQUENTIAL kernel 2
+    (``TopDownBFS.cpp:437-479``; work ∝ frontier is the reference's
+    top-down property, ``BFSFriends.h:59-182``; the bottom-up regime is
+    Beamer's, ``DirOptBFS.cpp:374-424``).
+
+    Measured scale-20 R-MAT level anatomy (benchmarks/results/r5, host
+    profile): one step is heavy (expanding L2: 6-26M frontier edges —
+    the dense sweep's regime), the steps before it have TINY frontiers
+    (≤350K edges), and from L3 on the UNDISCOVERED side collapses
+    (31K-445K edges among undiscovered rows). So each level picks, on
+    device, the first fitting strategy from ``tiers``:
+
+      ("td", budgets) — top-down class-bucketed CSC column walk: active
+        columns are degree-classed on ``BFS_CLASS_LADDER``, compacted by
+        ONE top_k (sort), and each class c walks at most budgets[c]
+        columns with a [F_c, K_c] static gather; parents scatter-max
+        into rows. Work ∝ Σ F_c·K_c (~1.5M slots for the default small
+        tier).
+      ("bu", budgets) — bottom-up class-bucketed CSR row walk: same
+        machinery over UNDISCOVERED rows; each row folds its in-edge
+        candidates with a gather (NO edge-sized scatter — the r1 lesson
+        that built EllParMat), then one [ΣF_c]-sized row scatter.
+      else — the dense ELL gather sweep (cost ~nnz slots, 0.3 s at
+        scale 20).
+
+    Budget semantics: class c may hold at most budgets[c] active
+    vertices (0 = none allowed); any vertex past the ladder's last rung
+    forces the next strategy. Conditions are 7 masked reductions per
+    side per level, computed once.
+
+    TPU-pathology notes baked into this design (probe_seq_r5):
+    in-program iota/cumsum/1-D megascatter serialize on this backend
+    (1.6-1.9 s per 1M elements; 39.5 s-vs-1.7 s for the v9/v7 program
+    pair), so compaction is top_k (sort, ~50 ms/M), iota and gid tables
+    are passed as materialized operands, and all index math is gathers.
+
+    W=1 also kills the batch kernels' two other single-root taxes: the
+    gather payload is a SCALAR (no 128-lane padding waste), and parents
+    ride the gathers directly as int32 candidates (no reconstruction
+    pass) — the frontier value of column c is c's global id, exactly
+    the reference's SelectMax parent semantics (Semirings.h:166).
+
+    Whole traversal is ONE launch (lax.while_loop + lax.switch; zero
+    host readbacks).
+
+    CLOSURE-CONSTANT LAW (this backend, measured): the gid/iota tables
+    must be CLOSED OVER by the jitted program, not passed as arguments —
+    the identical loop runs 1.65 s with them as closure constants and
+    27.3 s as parameters (probe_seq_r5 w4 vs w7; in-program jnp.arange
+    is 39.5 s, v9). Hence this factory: one cached jitted program per
+    (grid, shape, tiers), taking only the per-graph arrays as arguments.
+
+    Returns ``run(source, csc_indptr, csc_rowidx, csr_indptr,
+    csr_colidx, coldeg, rowdeg, *flat_bucket_arrays) -> (parents,
+    levels, niter)`` over plain [pr, lr] block arrays.
+    """
+    import functools
+
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.grid import COL_AXIS, ROW_AXIS
+    from ..parallel.spmat import TILE_SPEC
+
+    n = nrows
+    lr = grid.local_rows(n)
+    lc = grid.local_cols(ncols)
+    nb = nbuckets
+    iters = max_iters if max_iters is not None else n
+    LADDER = BFS_CLASS_LADDER
+    NC = len(LADDER)
+    assert lc <= 1 << 21 and lr <= 1 << 21, "class sort packs ids in 21 bits"
+    row_gids = _gid_blocks(grid, grid.pr, lr, n, "row")
+    col_gids = _gid_blocks(grid, grid.pc, lc, ncols, "col")
+    iota_k = _iota_operand(LADDER[-1])
+
+    @jax.jit
+    def run(source, csc_indptr, csc_rowidx, csr_indptr, csr_colidx,
+            coldeg, rowdeg, *flat_args):
+        parents0 = jnp.where(row_gids == source, jnp.int32(source), -1)
+        levels0 = jnp.where(row_gids == source, 0, -1).astype(jnp.int32)
+        # frontier: col-aligned int32 parent candidates (vertex's own
+        # global id when in the frontier, -1 inactive)
+        x0 = jnp.where(col_gids == source, jnp.int32(source), -1)
+
+        def classify(d):
+            """Degree → ladder class (0..NC-1; NC = beyond the ladder)."""
+            c = jnp.zeros_like(d)
+            for K in LADDER:
+                c = c + (d > K).astype(d.dtype)
+            return c
+
+        def class_counts(mask, degblocks):
+            """[NC+1] active-vertex count per class (last = beyond ladder)."""
+            d = jnp.where(mask, degblocks, -1)
+            lo = -1
+            cnts = []
+            for K in LADDER:
+                cnts.append(jnp.sum(((d > lo) & (d <= K)).astype(jnp.int32)))
+                lo = K
+            cnts.append(jnp.sum((d > LADDER[-1]).astype(jnp.int32)))
+            return cnts
+
+        def dense_level(x, undisc):
+            """Dense ELL gather sweep (the heavy-step regime): one
+            scalar-payload gather over every ELL slot, parents carried."""
+
+            def body(xblk, ublk, *flat):
+                buckets = [
+                    tuple(a[0, 0] for a in flat[3 * i : 3 * i + 3])
+                    for i in range(nb)
+                ]
+                xv = xblk[0]  # [lc] i32 candidates
+                xpad = jnp.concatenate([xv, jnp.full((1,), -1, jnp.int32)])
+                y = jnp.full((lr,), -1, jnp.int32)
+                for bc, _bv, br in buckets:
+                    g = xpad[jnp.minimum(bc, lc)]  # [nb_, kb] i32
+                    yb = jnp.max(g, axis=1)
+                    y = y.at[br].max(yb, mode="drop")
+                y = jnp.where(ublk[0], y, -1)
+                return jax.lax.pmax(y, COL_AXIS)[None]
+
+            return jax.shard_map(
+                body, mesh=grid.mesh,
+                in_specs=(P(COL_AXIS), P(ROW_AXIS)) + (TILE_SPEC,) * (3 * nb),
+                out_specs=P(ROW_AXIS),
+                check_vma=False,
+            )(x, undisc, *flat_args)
+
+        def _classed_walk(kind, budgets):
+            """Shared class-bucketed walk for both directions.
+
+            td: compact ACTIVE COLUMNS, walk their CSC ranges, scatter-max
+            parent candidates into rows ([F_c, K_c] edge scatter).
+            bu: compact UNDISCOVERED ROWS, walk their CSR ranges, fold each
+            row's neighbor candidates by gather-max, one [F_c] row scatter.
+            """
+            # cap per-class budgets at the block length: oversized
+            # static budgets (tuned for scale 20) would make small-graph
+            # walks gather more slots than the whole matrix
+            L_cap = lc if kind == "td" else lr
+            budgets = tuple(min(b, L_cap) for b in budgets)
+            FT = sum(b for b in budgets if b > 0)
+
+            def body(ipt, vidx, iota, xblk, ublk, cdgb, rdgb, gidb):
+                indptr = ipt[0, 0]
+                vid = vidx[0, 0]  # csc: rowidx / csr: colidx
+                xv = xblk[0]  # [lc] i32 frontier candidates
+                ub = ublk[0]  # [lr] bool undiscovered
+                xpad = jnp.concatenate([xv, jnp.full((1,), -1, jnp.int32)])
+                ipt_pad = jnp.concatenate([indptr, indptr[-1:]])
+                if kind == "td":
+                    L, gdeg, gid = lc, cdgb[0], gidb[0]
+                    active = xv >= 0
+                    ax = COL_AXIS
+                else:
+                    L, gdeg, gid = lr, rdgb[0], gidb[0]
+                    active = ub & (gid >= 0)
+                    ax = ROW_AXIS
+                j = jax.lax.axis_index(ax)
+                lid = gid - j * L  # local index within this block
+                dcls = classify(gdeg)
+                key = jnp.where(
+                    active & (dcls < NC),
+                    ((NC - dcls) << 21) | lid,
+                    -1,
+                )
+                k = min(FT, L)
+                topv, _ = jax.lax.top_k(key, k)  # class-asc, id-desc blocks
+                ids = jnp.where(topv >= 0, topv & 0x1FFFFF, L)
+                if k < FT:
+                    ids = jnp.pad(ids, (0, FT - k), constant_values=L)
+                # per-class starts (tiny scalar chain, not a prefix op)
+                d_act = jnp.where(active, gdeg, -1)
+                lo = -1
+                starts, start = [], jnp.int32(0)
+                for K in LADDER:
+                    starts.append(start)
+                    start = start + jnp.sum(
+                        ((d_act > lo) & (d_act <= K)).astype(jnp.int32)
+                    )
+                    lo = K
+                cap = vid.shape[0]
+                gdeg_pad = jnp.concatenate([gdeg, jnp.zeros((1,), gdeg.dtype)])
+                y = jnp.full((lr,), -1, jnp.int32)
+                lo = -1
+                for c, K in enumerate(LADDER):
+                    F = budgets[c]
+                    if F <= 0:
+                        lo = K
+                        continue
+                    sl = jax.lax.dynamic_slice(ids, (starts[c],), (F,))
+                    safe = jnp.minimum(sl, L)
+                    gd = gdeg_pad[safe]
+                    # class membership re-check excludes clamp/pad strays
+                    okc = (sl < L) & (gd > lo) & (gd <= K)
+                    st = ipt_pad[safe]
+                    ldeg = ipt_pad[jnp.minimum(sl + 1, L)] - st
+                    ik = iota[:K][None, :]  # static slice of the operand
+                    valid = okc[:, None] & (ik < ldeg[:, None])
+                    slot = jnp.where(valid, st[:, None] + ik, cap - 1)
+                    other = jnp.where(valid, vid[slot], lc)
+                    if kind == "td":
+                        # scatter parent candidates into target rows
+                        tgt = jnp.where(valid, other, lr)
+                        contrib = jnp.where(
+                            valid, xpad[jnp.minimum(safe, lc)][:, None], -1
+                        )
+                        y = y.at[tgt].max(contrib, mode="drop")
+                    else:
+                        # fold neighbor candidates per row, tiny row scatter
+                        g = jnp.where(
+                            valid, xpad[jnp.minimum(other, lc)], -1
+                        )
+                        yb = jnp.max(g, axis=1)  # [F]
+                        y = y.at[jnp.where(okc, sl, lr)].max(
+                            yb, mode="drop"
+                        )
+                    lo = K
+                y = jnp.where(ub, y, -1)
+                return jax.lax.pmax(y, COL_AXIS)[None]
+
+            ipt, vidx = (csc_indptr, csc_rowidx) if kind == "td" else (
+                csr_indptr, csr_colidx
+            )
+            gidb = col_gids if kind == "td" else row_gids
+            gid_spec = P(COL_AXIS) if kind == "td" else P(ROW_AXIS)
+
+            def run(x, undisc):
+                return jax.shard_map(
+                    body, mesh=grid.mesh,
+                    in_specs=(TILE_SPEC, TILE_SPEC, P(), P(COL_AXIS),
+                              P(ROW_AXIS), P(COL_AXIS), P(ROW_AXIS),
+                              gid_spec),
+                    out_specs=P(ROW_AXIS),
+                    check_vma=False,
+                )(ipt, vidx, iota_k, x, undisc, coldeg, rowdeg, gidb)
+
+            return run
+
+        branches = [
+            _classed_walk(kind, budgets) for kind, budgets in tiers
+        ] + [dense_level]
+
+        def cond(state):
+            _, _, _, level, active = state
+            return active & (level < iters)
+
+        def step(state):
+            parents, levels, x, level, _ = state
+            undisc = parents < 0
+            if tiers:
+                fc = class_counts(x >= 0, coldeg)
+                uc = class_counts(undisc & (row_gids >= 0), rowdeg)
+                sel = jnp.int32(len(tiers))
+                for t in reversed(range(len(tiers))):
+                    kind, budgets = tiers[t]
+                    cnts = fc if kind == "td" else uc
+                    ok = cnts[NC] == 0
+                    for c in range(NC):
+                        ok = ok & (cnts[c] <= budgets[c])
+                    sel = jnp.where(ok, jnp.int32(t), sel)
+                y = jax.lax.switch(sel, branches, x, undisc)
+            else:
+                y = dense_level(x, undisc)  # tiers=(): always-dense path
+            new = (y >= 0) & undisc & (row_gids >= 0)
+            parents = jnp.where(new, y, parents)
+            levels = jnp.where(new, level + 1, levels)
+            frontier_row = DistVec(
+                blocks=jnp.where(new, row_gids, -1), length=n, align="row",
+                grid=grid,
+            )
+            x_next = frontier_row.realign("col").blocks
+            return parents, levels, x_next, level + 1, jnp.any(new)
+
+        parents, levels, _, niter, _ = jax.lax.while_loop(
+            cond, step, (parents0, levels0, x0, jnp.int32(0),
+                         jnp.bool_(True))
+        )
+        # PLAIN ARRAYS out: DistVec-wrapping inside the jit executes
+        # ~60x slower on this backend (probe wa 1.6 s vs wc 110 s)
+        return parents, levels, niter
+
+    return run
+
+
+@jax.jit
+def single_traversed_edges(deg_row_blocks, parents: DistVec) -> jax.Array:
+    """Kernel-2 edge count for one root, on device (uint32-safe like
+    ``batch_traversed_edges``): sum of degrees over discovered / 2."""
+    disc = parents.blocks >= 0  # [pr, lr]
+    te = jnp.sum(
+        jnp.where(disc, deg_row_blocks, 0).astype(jnp.uint32)
+    )
+    return (te // 2).astype(jnp.int32)
 
 
 @jax.jit

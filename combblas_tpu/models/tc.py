@@ -54,6 +54,78 @@ def _tc_dense(rows, cols, n: int) -> jax.Array:
     return jnp.stack([hi, lo])
 
 
+#: Edge-harvest ceiling: the dense symmetric adjacency must fit HBM
+#: (bf16 n^2 = 8.6 GB at n = 65536; n = 131072 would need 34 GB).
+EDGE_HARVEST_MAX_DIM = 65536
+
+
+def _tc_edge_harvest(rows, cols, n: int, chunk: int = 4096) -> jax.Array:
+    """One-launch TC past the dense-product ceiling (32K < n <= 64K):
+    per-EDGE common-neighbor harvest against the dense adjacency.
+
+    The full dense wedge product is 2n^3 FLOPs (~560 TFLOP at n = 64K —
+    ~42 s even at MXU peak) and its f32 output doesn't fit HBM next to
+    the operand. But TC only needs (A·A)[i,j] ON the edges: for each
+    undirected edge (i>j), |N(i) ∩ N(j)| = <D[i,:], D[j,:]> = number of
+    triangles through that edge, so
+
+        3·T = Σ_{edges i>j} <D[i,:], D[j,:]>
+
+    which is 2·nnz/2·n ≈ 1.3e11 multiply-adds (4000x fewer than dense)
+    and is HBM-BOUND: ~2 full-row loads per edge ≈ nnz·n·2 B of traffic.
+    A lax.scan walks static edge chunks; each step gathers [chunk, n]
+    bf16 row pairs and dots them on the VPU (0/1 bf16 products are
+    exact; per-edge counts < n < 2^24 are f32-exact).
+
+    Returns the (hi, lo) int32 split of 3·T (``_tc_combine`` // 3 gives
+    T; 3·T can exceed 2^31 — same split rationale as ``_tc_dense``).
+
+    Reference role: the masked Mult_AnXBn of TC.cpp:104-116, redesigned
+    output-driven for a chip with no scatter unit (the ESC sparse path
+    pays the 22 M/s random-memory wall — 87 s at scale 16).
+    """
+    npad = -(-n // 128) * 128
+    loops = rows == cols
+    # dense SYMMETRIC adjacency (input is symmetrized; drop loops; padded
+    # sentinel slots land in the dump row npad-? -> use drop mode)
+    r_all = jnp.where(loops, npad, rows)
+    d = jnp.zeros((npad, npad), jnp.bfloat16)
+    d = d.at[r_all, cols].set(jnp.bfloat16(1.0), mode="drop")
+    # strict-lower edge list, padded slots -> row 0 x col 0 with weight 0
+    keep = rows > cols
+    nedge = rows.shape[0]
+    epad = -(-nedge // chunk) * chunk
+    er = jnp.where(keep, rows, 0)
+    ec = jnp.where(keep, cols, 0)
+    ew = keep.astype(jnp.float32)
+    er = jnp.pad(er, (0, epad - nedge))
+    ec = jnp.pad(ec, (0, epad - nedge))
+    ew = jnp.pad(ew, (0, epad - nedge))
+
+    def body(carry, eidx):
+        hi, lo = carry
+        ri = er[eidx]  # [chunk]
+        ci = ec[eidx]
+        wi = ew[eidx]
+        gi = d[ri]  # [chunk, npad] bf16
+        gj = d[ci]
+        w = jnp.einsum(
+            "bn,bn->b", gi, gj, preferred_element_type=jnp.float32
+        )
+        cnt = (w * wi).astype(jnp.int32)  # per-edge: exact (< n < 2^24)
+        # renormalize the split each step: an unbounded lo accumulation
+        # would itself wrap past 2^31 on triangle-rich graphs (the exact
+        # regime this kernel exists for)
+        lo = lo + jnp.sum(cnt & 0x7FFF)
+        hi = hi + jnp.sum(cnt >> 15) + (lo >> 15)
+        lo = lo & 0x7FFF
+        return (hi, lo), None
+
+    idx = jnp.arange(epad, dtype=jnp.int32).reshape(-1, chunk)
+    (hi, lo), _ = jax.lax.scan(body, (jnp.int32(0), jnp.int32(0)), idx)
+    return jnp.stack([hi, lo])
+
+
 def _tc_combine(hilo) -> int:
     """Exact host-side total from ``_tc_dense``'s (hi, lo) split."""
     import numpy as np
@@ -75,16 +147,27 @@ def triangle_count(A: SpParMat, kernel: str = "auto") -> int:
     (TC.cpp:104-116 flow) used for large or sharded inputs.
     """
     if kernel == "auto":
-        kernel = (
-            "dense"
-            if A.grid.size == 1 and max(A.nrows, A.ncols) <= DENSE_MAX_DIM
-            else "sparse"
-        )
+        if A.grid.size == 1 and max(A.nrows, A.ncols) <= DENSE_MAX_DIM:
+            kernel = "dense"
+        elif (
+            A.grid.size == 1
+            and max(A.nrows, A.ncols) <= EDGE_HARVEST_MAX_DIM
+        ):
+            kernel = "edgeharvest"
+        else:
+            kernel = "sparse"
     if kernel == "dense":
         t = A.local_tile(A.rows, A.cols, A.vals, A.nnz)
         return _tc_combine(
             jax.jit(_tc_dense, static_argnums=2)(t.rows, t.cols, A.nrows)
         )
+    if kernel == "edgeharvest":
+        t = A.local_tile(A.rows, A.cols, A.vals, A.nnz)
+        return _tc_combine(
+            jax.jit(_tc_edge_harvest, static_argnums=2)(
+                t.rows, t.cols, A.nrows
+            )
+        ) // 3
     L = A.remove_loops().tril(strict=True).apply(ones_f32)
     B = spgemm(PLUS_TIMES, L, L)  # B[i,j] = # wedges i->k->j with i>k>j
     C = B.ewise_mult(L)  # keep wedge counts only where edge (i,j) closes

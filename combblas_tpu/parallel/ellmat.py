@@ -104,6 +104,39 @@ class EllParMat:
         per sweep), measurably better for 1-lane payloads (single-vector
         SpMV) which cannot amortize the extra per-bucket sweeps.
         """
+        host = EllParMat.host_build(
+            grid, rows, cols, vals, nrows, ncols, max_k=max_k,
+            ladder=ladder,
+        )
+        return EllParMat.from_host_buckets(grid, host, nrows, ncols)
+
+    @staticmethod
+    def from_host_buckets(
+        grid: Grid, host_buckets, nrows: int, ncols: int
+    ) -> "EllParMat":
+        """Upload pre-built host bucket arrays (``host_build`` output, or
+        the same arrays round-tripped through an .npz): one device_put per
+        array — the bench protocol's cheap per-child path (the parent
+        builds once on host; children only upload)."""
+        sh = grid.tile_sharding()
+        put = lambda x: jax.device_put(jnp.asarray(x), sh)
+        return EllParMat(
+            buckets=tuple(
+                (put(bc), put(bv), put(br)) for bc, bv, br in host_buckets
+            ),
+            nrows=int(nrows), ncols=int(ncols), grid=grid,
+        )
+
+    @staticmethod
+    def host_build(
+        grid: Grid, rows, cols, vals, nrows: int, ncols: int,
+        max_k: int | None = None, ladder: str = "fine",
+    ):
+        """HOST-ONLY bucket construction (no device touch): returns a list
+        of (bc, bv, br) numpy arrays — the serializable half of
+        ``from_host_coo``, split out so a bench parent process can build
+        once and ship the arrays to timing children via .npz without ever
+        attaching to the chip itself."""
         from .spmat import bucket_by_tile
 
         vals = np.asarray(vals)
@@ -170,13 +203,8 @@ class EllParMat:
                 bc[i, j, :m] = np.where(valid, c[idx], lc)
                 bv[i, j, :m] = np.where(valid, v[idx], 0)
                 br[i, j, :m] = srow
-            sh = grid.tile_sharding()
-            put = lambda x: jax.device_put(jnp.asarray(x), sh)
-            buckets.append((put(bc), put(bv), put(br)))
-        return EllParMat(
-            buckets=tuple(buckets), nrows=int(nrows), ncols=int(ncols),
-            grid=grid,
-        )
+            buckets.append((bc, bv, br))
+        return buckets
 
     @staticmethod
     def from_spmat(
@@ -583,6 +611,43 @@ def build_csc_companion(grid: Grid, rows, cols, nrows: int, ncols: int):
     nnz. The EllParMat's row buckets cannot walk COLUMNS; sparse
     union-frontier steps need exactly that (the reference's SpImpl CSC
     kernels, SpImpl.cpp:345-600)."""
+    indptr, rowidx = build_csc_companion_host(grid, rows, cols, nrows, ncols)
+    return upload_csc_companion(grid, indptr, rowidx)
+
+
+def upload_csc_companion(grid: Grid, indptr, rowidx):
+    """Upload pre-built host CSC arrays (``build_csc_companion_host``)."""
+    sh = grid.tile_sharding()
+    return (
+        jax.device_put(jnp.asarray(indptr), sh),
+        jax.device_put(jnp.asarray(rowidx), sh),
+    )
+
+
+def build_csr_companion(grid: Grid, rows, cols, nrows: int, ncols: int):
+    """Row-major twin of ``build_csc_companion``: (indptr [pr, pc, lr+1],
+    colidx [pr, pc, cap]) — per-tile ROW walks for the bottom-up BFS
+    regime (``models/bfs.py`` "bu" tiers). For a SYMMETRIC matrix on a
+    1x1 grid the CSC companion arrays are identical and may be reused."""
+    indptr, colidx = build_csr_companion_host(grid, rows, cols, nrows, ncols)
+    return upload_csc_companion(grid, indptr, colidx)
+
+
+def build_csr_companion_host(grid: Grid, rows, cols, nrows: int, ncols: int):
+    """Host-only half of ``build_csr_companion`` (numpy in, numpy out)."""
+    return _companion_host(grid, rows, cols, nrows, ncols, major="row")
+
+
+def build_csc_companion_host(grid: Grid, rows, cols, nrows: int, ncols: int):
+    """Host-only half of ``build_csc_companion`` (numpy in, numpy out) —
+    serializable for the bench parent → timing-children .npz handoff."""
+    return _companion_host(grid, rows, cols, nrows, ncols, major="col")
+
+
+def _companion_host(grid, rows, cols, nrows, ncols, *, major):
+    """Shared per-tile walk-structure builder: sort each tile's tuples by
+    the major axis, indptr over that axis, minor indices padded with the
+    minor block size as the inert sentinel."""
     import numpy as np
 
     from .spmat import bucket_by_tile
@@ -592,24 +657,19 @@ def build_csc_companion(grid: Grid, rows, cols, nrows: int, ncols: int):
     )
     pr_, pc_ = grid.pr, grid.pc
     cap = max(int(counts.max()), 1)
-    indptr = np.zeros((pr_, pc_, lc + 1), np.int32)
-    rowidx = np.full((pr_, pc_, cap), lr, np.int32)
+    lmaj, lmin = (lr, lc) if major == "row" else (lc, lr)
+    indptr = np.zeros((pr_, pc_, lmaj + 1), np.int32)
+    minidx = np.full((pr_, pc_, cap), lmin, np.int32)
     for t in range(grid.size):
         i, j = divmod(t, pc_)
         s0, e0 = starts[t], starts[t + 1]
         r = rows[s0:e0] - i * lr
         c = cols[s0:e0] - j * lc
-        o = np.argsort(c, kind="stable")
-        r, c = r[o], c[o]
-        indptr[i, j] = np.searchsorted(c, np.arange(lc + 1))
-        rowidx[i, j, : e0 - s0] = r
-    sh = grid.tile_sharding()
-    import jax.numpy as jnp
-
-    return (
-        jax.device_put(jnp.asarray(indptr), sh),
-        jax.device_put(jnp.asarray(rowidx), sh),
-    )
+        maj, mino = (r, c) if major == "row" else (c, r)
+        o = np.argsort(maj, kind="stable")
+        indptr[i, j] = np.searchsorted(maj[o], np.arange(lmaj + 1))
+        minidx[i, j, : e0 - s0] = mino[o]
+    return indptr, minidx
 
 
 @partial(jax.jit, static_argnames=("frontier_capacity", "edge_capacity"))

@@ -350,3 +350,20 @@ def test_triangle_count_dense_kernel(rng):
     want = triangle_count(A, kernel="sparse")
     got = triangle_count(A, kernel="dense")
     assert got == want
+
+
+def test_triangle_count_edge_harvest_kernel(rng):
+    """Round-5 edge-harvest TC (dense-row gathers per edge, the
+    32K < n <= 64K regime) must match the sparse and dense paths,
+    including when the edge count doesn't divide the scan chunk."""
+    from combblas_tpu.models.tc import triangle_count
+
+    grid = Grid.make(1, 1)
+    n = 48
+    d = (rng.random((n, n)) < 0.3).astype(np.float32)
+    d = np.maximum(d, d.T)
+    np.fill_diagonal(d, 0.0)
+    A = SpParMat.from_dense(grid, d)
+    want = triangle_count(A, kernel="sparse")
+    assert triangle_count(A, kernel="edgeharvest") == want
+    assert triangle_count(A, kernel="dense") == want

@@ -305,3 +305,74 @@ def test_validate_bfs_device(shape, rng):
     l_bad = DistMultiVec.from_global(grid, lg2.astype(np.int32), align="row")
     v3 = np.asarray(validate_bfs_device(E, p, l_bad))
     assert v3[1, 0] > 0 or v3[3, 0] > 0
+
+
+@pytest.mark.parametrize("shape", [(1, 1), (2, 2), (2, 4)])
+def test_bfs_single_matches(shape):
+    """Single-root tiered BFS (the spec's sequential kernel 2): identical
+    levels to the reference bfs() and a valid tree, across tier regimes
+    (tiny tiers forcing dense, generous tiers keeping everything sparse,
+    and a mixed ladder)."""
+    from combblas_tpu.models.bfs import bfs, bfs_single, validate_bfs_tree
+    from combblas_tpu.parallel.ellmat import EllParMat, build_csc_companion
+    from combblas_tpu.parallel.spmat import SpParMat
+
+    rows, cols = rmat_symmetric_coo(jax.random.key(31), 8, 6)
+    n = 1 << 8
+    grid = Grid.make(*shape)
+    rr, cc = np.asarray(rows), np.asarray(cols)
+    E = EllParMat.from_host_coo(
+        grid, rr, cc, np.ones(len(rr), np.float32), n, n
+    )
+    A = SpParMat.from_global_coo(
+        grid, rr, cc, np.ones(len(rr), np.float32), n, n
+    )
+    csc = build_csc_companion(grid, rr, cc, n, n)
+    from combblas_tpu.parallel.ellmat import build_csr_companion
+
+    csr = build_csr_companion(grid, rr, cc, n, n)
+    deg = np.bincount(rr, minlength=n)
+    d = np.zeros((n, n), bool)
+    d[rr, cc] = True
+    big = (n, n, n, n, n, n)
+    for s in np.flatnonzero(deg > 0)[[0, 7]]:
+        p0, l0, _ = bfs(A, int(s))
+        L0 = l0.to_global()
+        for tiers in (
+            (("td", (1, 0, 0, 0, 0, 0)),),     # forces dense nearly always
+            (("td", big),),                    # everything top-down
+            (("bu", big),),                    # everything bottom-up
+            (("td", (4, 2, 1, 0, 0, 0)), ("bu", (16, 8, 2, 0, 0, 0)),
+             ("td", big)),                     # mixed ladder
+        ):
+            p1, l1, _ = bfs_single(E, int(s), csc, csr=csr, tiers=tiers)
+            np.testing.assert_array_equal(L0, l1.to_global(), err_msg=str(tiers))
+            assert not validate_bfs_tree(
+                d, int(s), p1.to_global(), l1.to_global()
+            ), tiers
+
+
+def test_single_traversed_edges_matches():
+    from combblas_tpu.models.bfs import (
+        bfs_single, single_traversed_edges,
+    )
+    from combblas_tpu.parallel.ellmat import EllParMat, build_csc_companion
+
+    rows, cols = rmat_symmetric_coo(jax.random.key(5), 8, 6)
+    n = 1 << 8
+    grid = Grid.make(2, 2)
+    rr, cc = np.asarray(rows), np.asarray(cols)
+    E = EllParMat.from_host_coo(
+        grid, rr, cc, np.ones(len(rr), np.float32), n, n
+    )
+    csc = build_csc_companion(grid, rr, cc, n, n)
+    deg = np.bincount(rr, minlength=n)
+    s = int(np.flatnonzero(deg > 0)[0])
+    p, _, _ = bfs_single(E, s, csc, tiers=(("td", (64, 64, 64, 0, 0, 0)),))
+    lr = grid.local_rows(n)
+    degb = jnp.asarray(
+        np.pad(deg, (0, lr * grid.pr - n)).reshape(grid.pr, lr), jnp.int32
+    )
+    te = int(np.asarray(single_traversed_edges(degb, p)))
+    P = p.to_global()
+    assert te == int(deg[P >= 0].sum()) // 2
