@@ -158,29 +158,19 @@ BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "1200"))
 # small top-down tier for the pre-peak levels, two bottom-up tiers for
 # the post-peak levels (measured scale-20 level anatomy: one dense step
 # per traversal), dense for the peak
-SEQ_TIERS = os.environ.get(
-    "BENCH_SEQ_TIERS",
-    "td:1024,1024,512,128,16,2"
-    "|bu:524288,16384,1024,0,0,0"
-    "|bu:1048576,32768,2048,128,0,0",
-)
+from combblas_tpu.models.bfs import DEFAULT_SEQ_TIERS  # noqa: E402
+
+SEQ_TIERS = os.environ.get("BENCH_SEQ_TIERS", DEFAULT_SEQ_TIERS)
 BASELINE_MTEPS = 1636.0  # Hopper 1024 cores, R-MAT "mini"
 OPERATING_MTEPS = 297.0  # recorded sweep at scale 20 / W=256 (r2h)
-CACHE_DIR = os.path.join(
-    os.path.dirname(os.path.abspath(__file__)), ".jax_cache"
-)
-
-
 def _enable_compile_cache():
-    """Persistent compilation cache (works through the axon remote
-    compiler — measured 2.7 s -> 0.5 s cold-process recompile): children
-    share compiled programs with each other and with prior runs, so the
-    16 sequential-root processes compile bfs_single exactly once."""
-    import jax
+    """Persistent compilation cache (see utils/compile_cache.py):
+    children share compiled programs with each other and with prior
+    runs, so the 16 sequential-root processes compile bfs_single exactly
+    once. BENCH_NOCACHE=1 disables (diagnostic)."""
+    from combblas_tpu.utils.compile_cache import enable_compile_cache
 
-    jax.config.update("jax_compilation_cache_dir", CACHE_DIR)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
-    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    enable_compile_cache()
 
 
 def build_graph_npz(path: str) -> float:
@@ -239,6 +229,7 @@ def augment_npz_with_structures(path: str) -> float:
         grid, z["rows"], z["cols"], n, n
     )
     z["csc_indptr"], z["csc_rowidx"] = indptr, rowidx
+    z["nnz"] = np.int64(len(z["rows"]))
     z["ell_nbuckets"] = np.int32(len(buckets))
     for b, (bc, _bv, br) in enumerate(buckets):
         z[f"ell{b}_bc"] = bc
@@ -255,6 +246,7 @@ def k1_device_child(path: str):
     BFS children, and report per-stage construction timings.  This makes
     the official construction_s the distributed pipeline's number
     (SpParMat.cpp:3140-3441 role) instead of the host numpy path."""
+    _enable_compile_cache()
     import jax
     import numpy as np
 
@@ -458,7 +450,9 @@ def child(graph_path: str):
     t0 = time.perf_counter()
     data = np.load(graph_path)
     deg, roots = data["deg"], data["roots"]
-    nnz = len(data["rows"])
+    nnz = (
+        int(data["nnz"]) if "nnz" in data else len(data["rows"])
+    )
     E, csc_arrays = _load_structures(grid, data, n, want_csc=DIROPT)
     csc = None
     fcap = ecap = None

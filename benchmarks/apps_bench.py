@@ -639,6 +639,199 @@ def bench_tc_dense():
     )
 
 
+def _enable_cache():
+    from combblas_tpu.utils.compile_cache import enable_compile_cache
+
+    enable_compile_cache()
+
+
+def bench_tc_edgeharvest():
+    """Round-5 scale-16 TC: per-edge common-neighbor harvest against the
+    dense bf16 adjacency (models/tc.py:_tc_edge_harvest) — the regime
+    past the n=32K dense-product ceiling where the ESC sparse path runs
+    9.23 MFLOP/s (87 s; VERDICT r4 Missing #2). AOT-compile, one timed
+    launch, readback closes the window."""
+    _enable_cache()
+    import jax
+    import numpy as np
+
+    from combblas_tpu.models.tc import (
+        _tc_combine,
+        _tc_edge_harvest,
+        _tc_edge_harvest_bits,
+    )
+    from combblas_tpu.parallel.grid import Grid
+    from combblas_tpu.parallel.spmat import SpParMat
+
+    r, c, n = _graph(SCALE, ef=8)
+    grid = Grid.make(1, 1)
+    A = SpParMat.from_global_coo(
+        grid, r, c, np.ones(len(r), np.float32), n, n
+    )
+    t = A.local_tile(A.rows, A.cols, A.vals, A.nnz)
+    chunk = int(os.environ.get("BENCH_TC_CHUNK", "8192"))
+    kern = (_tc_edge_harvest_bits
+            if os.environ.get("BENCH_TC_BITS", "1") == "1"
+            else _tc_edge_harvest)
+    fn = jax.jit(kern, static_argnums=(2, 3))
+    compiled = fn.lower(t.rows, t.cols, n, chunk).compile()
+    time.sleep(3)
+    t0 = time.perf_counter()
+    hilo = compiled(t.rows, t.cols)
+    total3 = _tc_combine(jax.device_get(hilo))  # readback = the barrier
+    dt = time.perf_counter() - t0
+    tri = total3 // 3
+    # sparse-flops equivalence for the standings table: the masked
+    # SpGEMM counts 2 ops per multiply over sum_{(i,j) in L} |N(i)| —
+    # report the same convention via the wedge count
+    print(json.dumps({
+        "metric": f"tc_edgeharvest_rmat_scale{SCALE}_s",
+        "kernel": kern.__name__,
+        "value": round(dt, 3),
+        "unit": "s",
+        "triangles": tri,
+        "nnz": len(r),
+        "n": n,
+        "traffic_GB": round(
+            len(r) * (-(-n // 32) * (4 if kern.__name__.endswith("bits")
+                                     else 64)) / 1e9, 1),
+        "GBps": round(
+            len(r) * (-(-n // 32) * (4 if kern.__name__.endswith("bits")
+                                     else 64)) / 1e9 / dt, 1),
+    }))
+
+
+def bench_matching_device():
+    """Round-5 chip capture for the ON-DEVICE augmenting matching
+    (models/matching.py:maximum_matching_device; VERDICT r4 item 6 +
+    Weak #7): each phase's wall time is recorded — phase 1 runs clean,
+    phases 2+ run after the phase-1 termination readback, so the
+    per-phase times ARE the answer to the D2H-poison question."""
+    _enable_cache()
+    import jax
+    import numpy as np
+
+    from combblas_tpu.models.matching import (
+        _mcm_phase,
+        maximal_matching,
+        ones_f32,
+    )
+    from combblas_tpu.parallel.grid import Grid
+    from combblas_tpu.parallel.spmat import SpParMat
+
+    r, c, n = _graph(SCALE)
+    grid = Grid.make(1, 1)
+    A = SpParMat.from_global_coo(
+        grid, r, c, np.ones(len(r), np.float32), n, n
+    )
+    t_all = time.perf_counter()
+    t0 = time.perf_counter()
+    mate_row, mate_col = maximal_matching(A)
+    jax.block_until_ready(mate_row.blocks)
+    init_s = time.perf_counter() - t0
+    AT = A.transpose().apply(ones_f32)
+    jax.block_until_ready(AT.vals)
+    phases = []
+    while True:
+        t0 = time.perf_counter()
+        mate_row, mate_col, n_aug = _mcm_phase(AT, mate_row, mate_col)
+        aug = int(n_aug)  # the per-phase readback (poisons later phases)
+        phases.append({"s": round(time.perf_counter() - t0, 3),
+                       "augmented": aug})
+        if aug == 0:
+            break
+    total = time.perf_counter() - t_all
+    card = int((np.asarray(mate_row.to_global()) >= 0).sum())
+    print(json.dumps({
+        "metric": f"matching_device_rmat_scale{SCALE}_s",
+        "value": round(total, 3),
+        "unit": "s",
+        "cardinality": card,
+        "n": n,
+        "nnz": len(r),
+        "init_maximal_s": round(init_s, 3),
+        "phases": phases,
+    }))
+
+
+def bench_rcm():
+    """Round-5 chip capture for RCM ordering (models/ordering.py;
+    RCM.cpp:61-160 role). End-to-end wall time including the
+    pseudo-peripheral probe (whose per-probe readbacks poison later
+    launches on this chip — recorded as-is, like the reference's
+    peripheral search is part of its timed driver)."""
+    _enable_cache()
+    import jax
+    import numpy as np
+
+    from combblas_tpu.models.ordering import rcm_ordering
+    from combblas_tpu.parallel.grid import Grid
+    from combblas_tpu.parallel.spmat import SpParMat
+
+    r, c, n = _graph(SCALE)
+    grid = Grid.make(1, 1)
+    A = SpParMat.from_global_coo(
+        grid, r, c, np.ones(len(r), np.float32), n, n
+    )
+    # warm the kernels with a fixed-root ordering (no peripheral probe)
+    p = rcm_ordering(A, root=0)
+    jax.block_until_ready(p.blocks)
+    time.sleep(3)
+    t0 = time.perf_counter()
+    p = rcm_ordering(A)
+    perm = np.asarray(p.to_global())
+    dt = time.perf_counter() - t0
+    ok = len(np.unique(perm[perm >= 0])) == n
+    print(json.dumps({
+        "metric": f"rcm_rmat_scale{SCALE}_s",
+        "value": round(dt, 3),
+        "unit": "s",
+        "n": n,
+        "nnz": len(r),
+        "is_permutation": bool(ok),
+    }))
+
+
+def bench_awpm():
+    """Round-5 chip capture for approximate-weight perfect matching
+    (models/matching.py:awpm; the BipartiteMatchings AWPM driver role)."""
+    _enable_cache()
+    import jax
+    import numpy as np
+
+    from combblas_tpu.models.matching import awpm
+    from combblas_tpu.parallel.grid import Grid
+    from combblas_tpu.parallel.spmat import SpParMat
+
+    r, c, n = _graph(SCALE)
+    rng = np.random.default_rng(5)
+    w = rng.random(len(r)).astype(np.float32) + 0.1
+    grid = Grid.make(1, 1)
+    A = SpParMat.from_global_coo(grid, r, c, w, n, n)
+    t0 = time.perf_counter()
+    mr, mc = awpm(A)
+    card = int((np.asarray(mr.to_global()) >= 0).sum())
+    dt = time.perf_counter() - t0
+    d = np.zeros((n, n), np.float32) if n <= 4096 else None
+    out = {
+        "metric": f"awpm_rmat_scale{SCALE}_s",
+        "value": round(dt, 3),
+        "unit": "s",
+        "cardinality": card,
+        "n": n,
+        "nnz": len(r),
+    }
+    # matched weight without densifying: sum w over matched (r -> mate)
+    mrg = np.asarray(mr.to_global())
+    matched = mrg >= 0
+    key = r * np.int64(n) + c
+    order = np.argsort(key)
+    mkey = np.flatnonzero(matched) * np.int64(n) + mrg[matched]
+    pos = np.searchsorted(key[order], mkey)
+    out["weight"] = round(float(w[order][pos].sum()), 2)
+    print(json.dumps(out))
+
+
 if __name__ == "__main__":
     if APP == "pagerank":
         bench_pagerank()
@@ -662,6 +855,14 @@ if __name__ == "__main__":
         bench_mcl()
     elif APP == "mcl_dense":
         bench_mcl_dense()
+    elif APP == "tc_edgeharvest":
+        bench_tc_edgeharvest()
+    elif APP == "matching_device":
+        bench_matching_device()
+    elif APP == "rcm":
+        bench_rcm()
+    elif APP == "awpm":
+        bench_awpm()
     elif APP == "tc_dense":
         bench_tc_dense()
     else:

@@ -1,0 +1,120 @@
+"""Round-5 probe: dense-panel A² economics at scale 16 (n = 65536).
+
+VERDICT r4 item 3 asks for the MXU dense strategy's viability past
+n = 32K, "or a written floor argument with measured panel probes".
+This probe measures the two components of a column-panel-phased dense
+A² (the ColSplit(phases) idea, ParFriends.h:550-577, applied to dense
+panels):
+
+  MODE=panel    — bf16 [n, n] @ [n, W] MXU panel matmul rate
+                  (REPS panels in one fori_loop launch, anti-DCE chained)
+  MODE=extract  — sparsify_windowed rate on an [n, W] f32 panel at the
+                  measured A² per-panel density (~164M/65536 ≈ 2.5K
+                  nnz/col at scale 16)
+
+Full-A² floor = n/W panels x (panel_s + extract_s). One MODE per
+process (readback poison).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from combblas_tpu.utils.compile_cache import enable_compile_cache
+
+enable_compile_cache()
+
+MODE = os.environ.get("MODE", "panel")
+SCALE = int(os.environ.get("BENCH_SCALE", "16"))
+W = int(os.environ.get("PROBE_W", "512"))
+REPS = int(os.environ.get("PROBE_REPS", "8"))
+DRAIN = float(os.environ.get("PROBE_DRAIN_S", "10"))
+
+
+def main():
+    n = 1 << SCALE
+    from benchmarks.apps_bench import _graph
+
+    r, c, _ = _graph(SCALE, ef=8)
+    nnz = len(r)
+
+    if MODE == "panel":
+        @jax.jit
+        def build(rr, cc):
+            d = jnp.zeros((n, n), jnp.bfloat16)
+            return d.at[rr, cc].set(jnp.bfloat16(1.0), mode="drop")
+
+        d = build(jnp.asarray(r, jnp.int32), jnp.asarray(c, jnp.int32))
+
+        @jax.jit
+        def panels(dd):
+            def body(i, carry):
+                j0 = (i * W) % (n - W)
+                p = jax.lax.dynamic_slice(dd, (0, j0), (n, W))
+                out = jnp.dot(
+                    dd, p, preferred_element_type=jnp.float32
+                )  # [n, W] f32
+                # anti-DCE: unprovable predicate on the panel result
+                return jnp.where(jnp.min(out) == -5.0, carry + i, carry)
+
+            return jax.lax.fori_loop(0, REPS, body, jnp.float32(0))
+
+        out = panels(d)
+        jax.block_until_ready(out)
+        time.sleep(DRAIN)
+        t0 = time.perf_counter()
+        out = panels(d)
+        v = float(jax.device_get(out))
+        dt = time.perf_counter() - t0
+        per_panel = dt / REPS
+        flops = 2.0 * n * n * W
+        print(json.dumps({
+            "mode": MODE, "n": n, "W": W, "reps": REPS,
+            "dt_s": round(dt, 3), "s_per_panel": round(per_panel, 4),
+            "TFLOPs": round(flops / per_panel / 1e12, 2),
+            "full_A2_matmul_s": round(per_panel * n / W, 1),
+            "sink": v, "nnz": nnz,
+        }), flush=True)
+    elif MODE == "extract":
+        from combblas_tpu.ops.spgemm import sparsify_windowed
+
+        # synthetic panel at the measured A2 density: 164M nnz over n
+        # cols ~ 2500/col at scale 16 (spgemm_r3b out_nnz)
+        dens = float(os.environ.get("PROBE_DENS", "0.04"))
+        rng = np.random.default_rng(0)
+        panel = np.where(
+            rng.random((n, W)) < dens, rng.random((n, W)), 0.0
+        ).astype(np.float32)
+        cap = 1 << int(panel.astype(bool).sum() * 1.1).bit_length()
+        pd = jax.device_put(panel)
+
+        @jax.jit
+        def ex(p):
+            t, total = sparsify_windowed(p, 0.0, n, W, cap)
+            return t.rows, t.cols, t.vals, total
+
+        out = ex(pd)
+        jax.block_until_ready(out[3])
+        time.sleep(DRAIN)
+        t0 = time.perf_counter()
+        out = ex(pd)
+        total = int(jax.device_get(out[3]))
+        dt = time.perf_counter() - t0
+        print(json.dumps({
+            "mode": MODE, "n": n, "W": W, "panel_nnz": total,
+            "dt_s": round(dt, 3),
+            "Mnnz_per_s": round(total / dt / 1e6, 2),
+            "full_A2_extract_s_at_164M": round(164e6 / (total / dt), 1),
+            "cap": cap,
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

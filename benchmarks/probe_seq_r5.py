@@ -24,12 +24,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 if os.environ.get("PROBE_NOCACHE") != "1":
-    jax.config.update(
-        "jax_compilation_cache_dir",
-        os.path.join(os.path.dirname(__file__), "..", ".jax_cache"))
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
-    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    from combblas_tpu.utils.compile_cache import enable_compile_cache
+
+    enable_compile_cache()
 
 from combblas_tpu.parallel.grid import Grid
 from combblas_tpu.ops.segment import expand_ranges
@@ -741,12 +740,9 @@ def main():
 
         from combblas_tpu.models.bfs import parse_tier_spec
 
-        spec = os.environ.get(
-            "BENCH_SEQ_TIERS",
-            "td:1024,1024,512,128,16,2"
-            "|bu:524288,16384,1024,0,0,0"
-            "|bu:1048576,32768,2048,128,0,0",
-        )
+        from combblas_tpu.models.bfs import DEFAULT_SEQ_TIERS
+
+        spec = os.environ.get("BENCH_SEQ_TIERS", DEFAULT_SEQ_TIERS)
         tiers = parse_tier_spec(spec)
         root = np.int32(data["roots"][int(os.environ.get("ROOT", "0"))])
         cdg = DistVec.from_global(grid, data["deg"], align="col").blocks

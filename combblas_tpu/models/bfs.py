@@ -590,6 +590,18 @@ def bfs_single(E, source, csc, *, tiers, csr=None, coldeg=None,
     return mk(parents), mk(levels), niter
 
 
+#: Default sequential-root tier ladder for Graph500-class graphs at
+#: scale ~20 (sized from the measured level anatomy in
+#: benchmarks/results/r5): a small top-down tier for the pre-peak
+#: levels, two bottom-up tiers for the post-peak levels, dense for the
+#: peak step. bench.py and the probes share this constant.
+DEFAULT_SEQ_TIERS = (
+    "td:1024,1024,512,128,16,2"
+    "|bu:524288,16384,1024,0,0,0"
+    "|bu:1048576,32768,2048,128,0,0"
+)
+
+
 def parse_tier_spec(spec: str):
     """``"td:1024,1024,512,128,16,2|bu:524288,16384,1024,0,0,0"`` →
     bfs_single tier tuple. Empty string → () (always-dense)."""
@@ -599,9 +611,13 @@ def parse_tier_spec(spec: str):
             continue
         kind, _, budg = part.partition(":")
         budgets = tuple(int(v) for v in budg.split(","))
-        assert kind in ("td", "bu") and len(budgets) == len(
+        if kind not in ("td", "bu") or len(budgets) != len(
             BFS_CLASS_LADDER
-        ), part
+        ):
+            raise ValueError(
+                f"bad tier spec {part!r}: want kind td|bu and "
+                f"{len(BFS_CLASS_LADDER)} budgets"
+            )
         tiers.append((kind, budgets))
     return tuple(tiers)
 

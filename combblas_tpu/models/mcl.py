@@ -393,11 +393,17 @@ def dense_mcl_program(n, npad, inflation, eps, max_iters, *, hard, select,
     >= eps, the state is multiplied by a deterministic per-entry jitter
     field (1 + perturb_delta * hash(i, j)/2^16) and re-normalized — an
     explicit, counted emulation of that residue (ties break
-    asymmetrically; the attractor loses its mirror symmetry). delta=5e-5
-    is far above f32 ulp yet 20x below the 1e-3 hard-threshold scale, so
-    it cannot move mass across the prune boundary on its own.
-    ``perturb_delta=0`` disables. The two post-perturbation iterations
-    are excused from the detector (chaos history resets to inf)."""
+    asymmetrically; the attractor loses its mirror symmetry). A lone
+    5e-5 jitter measured 21 ineffective kicks against the stable
+    scale-14 flip-flop, so each kick ALSO adds escalating self-loop mass
+    (alpha = delta*4^kicks, capped ~0.8) — van Dongen's flip-flop remedy
+    and the role of the reference's AdjustLoops colmax loops
+    (MCL.cpp:462-471). Early kicks are cluster-neutral; a deep
+    escalation trades the oscillating boundary vertices' assignment for
+    termination, and the artifact records the kick count
+    ("perturbations") so that trade is visible. ``perturb_delta=0``
+    disables. The two post-perturbation iterations are excused from the
+    detector (chaos history resets to inf)."""
     import jax
 
     from ..parallel.spgemm import _mxu_dot
@@ -428,14 +434,27 @@ def dense_mcl_program(n, npad, inflation, eps, max_iters, *, hard, select,
         c = c / jnp.where(rs > 0, rs, 1.0)
         return c, ch
 
-    def perturb(m):
-        """Deterministic per-entry jitter (1 + delta * h(i,j)), then row
-        re-normalization — the explicit f64-rounding-residue stand-in
-        that breaks a period-2 attractor's mirror symmetry."""
+    def perturb(args):
+        """Escalating self-loop damping + deterministic jitter, then row
+        re-normalization. Flip-flop limit cycles are STABLE attractors of
+        the MCL map (van Dongen §flip-flop; a 5e-5 jitter alone measured
+        21 ineffective kicks at chaos 0.24825, apps_bench r5) — the
+        classical cure is MORE LOOP MASS (the role of the reference's
+        AdjustLoops colmax loops, MCL.cpp:462-471), so each kick adds
+        alpha = delta * 4^k to the diagonal (k = kicks so far, capped at
+        alpha ~ 0.8) and breaks residual mirror symmetry with the tiny
+        per-entry jitter."""
+        m, npert = args
+        alpha = jnp.minimum(
+            perturb_delta
+            * jnp.exp2(2.0 * jnp.minimum(npert, 8).astype(jnp.float32)),
+            0.8,
+        )
         i = jnp.arange(npad, dtype=jnp.int32)[:, None]
         j = jnp.arange(npad, dtype=jnp.int32)[None, :]
         h = (i * jnp.int32(-1640531527) + j * jnp.int32(40503)) & 0xFFFF
         m = m * (1.0 + perturb_delta * h.astype(jnp.float32) / 65536.0)
+        m = m + alpha * jnp.eye(npad, dtype=jnp.float32)
         rs = jnp.sum(m, axis=1, keepdims=True)
         return m / jnp.where(rs > 0, rs, 1.0)
 
@@ -459,7 +478,9 @@ def dense_mcl_program(n, npad, inflation, eps, max_iters, *, hard, select,
                     & jnp.isfinite(ch2)
                     & (jnp.abs(ch - ch2) < 1e-3 * jnp.maximum(ch, 1e-30))
                 )
-                m2 = jax.lax.cond(stuck, perturb, lambda x: x, m2)
+                m2 = jax.lax.cond(
+                    stuck, perturb, lambda a: a[0], (m2, npert)
+                )
                 npert = npert + stuck.astype(jnp.int32)
                 # reset the history after a kick: the next two chaos
                 # values reflect the transient, not the attractor

@@ -54,9 +54,11 @@ def _tc_dense(rows, cols, n: int) -> jax.Array:
     return jnp.stack([hi, lo])
 
 
-#: Edge-harvest ceiling: the dense symmetric adjacency must fit HBM
-#: (bf16 n^2 = 8.6 GB at n = 65536; n = 131072 would need 34 GB).
+#: Edge-harvest ceilings: the symmetric adjacency must fit HBM — bf16
+#: n^2 bytes*2 (8.6 GB at n = 65536), bit-packed n^2/8 bytes (8.6 GB at
+#: n = 262144, i.e. scale 18 on the 16 GB chip).
 EDGE_HARVEST_MAX_DIM = 65536
+EDGE_HARVEST_BITS_MAX_DIM = 262144
 
 
 def _tc_edge_harvest(rows, cols, n: int, chunk: int = 4096) -> jax.Array:
@@ -126,6 +128,50 @@ def _tc_edge_harvest(rows, cols, n: int, chunk: int = 4096) -> jax.Array:
     return jnp.stack([hi, lo])
 
 
+def _tc_edge_harvest_bits(rows, cols, n: int, chunk: int = 8192) -> jax.Array:
+    """Bit-packed edge-harvest TC: the adjacency as a [n, n/32] uint32
+    bitmask; each edge's common-neighbor count is popcount(row_i & row_j).
+
+    Same mathematics as ``_tc_edge_harvest`` with 16x less gather
+    traffic (8 KB/row at n = 64K instead of 131 KB of bf16) — the
+    bf16 variant measured only ~12 GB/s of effective row-gather
+    bandwidth on the chip, so traffic is the knob that matters. Packing
+    is a scatter-ADD of 2^(c mod 32) at (r, c div 32): the input COO is
+    dedup'd, so add ≡ bitwise-or (each bit lands exactly once).
+
+    Returns the (hi, lo) int32 split of 3·T like ``_tc_edge_harvest``.
+    """
+    nw = -(-n // 32)
+    npad32 = nw * 32
+    loops = rows == cols
+    r_all = jnp.where(loops, npad32, rows)  # dropped by mode="drop"
+    bits = jnp.zeros((npad32, nw), jnp.uint32)
+    bits = bits.at[r_all, cols >> 5].add(
+        (jnp.uint32(1) << (cols.astype(jnp.uint32) & 31)), mode="drop"
+    )
+    keep = rows > cols
+    nedge = rows.shape[0]
+    epad = -(-nedge // chunk) * chunk
+    er = jnp.pad(jnp.where(keep, rows, 0), (0, epad - nedge))
+    ec = jnp.pad(jnp.where(keep, cols, 0), (0, epad - nedge))
+    ew = jnp.pad(keep.astype(jnp.int32), (0, epad - nedge))
+
+    def body(carry, eidx):
+        hi, lo = carry
+        gi = bits[er[eidx]]  # [chunk, nw] u32
+        gj = bits[ec[eidx]]
+        pc = jax.lax.population_count(gi & gj)  # [chunk, nw] u32
+        cnt = jnp.sum(pc.astype(jnp.int32), axis=1) * ew[eidx]
+        lo = lo + jnp.sum(cnt & 0x7FFF)
+        hi = hi + jnp.sum(cnt >> 15) + (lo >> 15)
+        lo = lo & 0x7FFF
+        return (hi, lo), None
+
+    idx = jnp.arange(epad, dtype=jnp.int32).reshape(-1, chunk)
+    (hi, lo), _ = jax.lax.scan(body, (jnp.int32(0), jnp.int32(0)), idx)
+    return jnp.stack([hi, lo])
+
+
 def _tc_combine(hilo) -> int:
     """Exact host-side total from ``_tc_dense``'s (hi, lo) split."""
     import numpy as np
@@ -151,7 +197,7 @@ def triangle_count(A: SpParMat, kernel: str = "auto") -> int:
             kernel = "dense"
         elif (
             A.grid.size == 1
-            and max(A.nrows, A.ncols) <= EDGE_HARVEST_MAX_DIM
+            and max(A.nrows, A.ncols) <= EDGE_HARVEST_BITS_MAX_DIM
         ):
             kernel = "edgeharvest"
         else:
@@ -161,10 +207,14 @@ def triangle_count(A: SpParMat, kernel: str = "auto") -> int:
         return _tc_combine(
             jax.jit(_tc_dense, static_argnums=2)(t.rows, t.cols, A.nrows)
         )
-    if kernel == "edgeharvest":
+    harvest = {
+        "edgeharvest": _tc_edge_harvest_bits,
+        "edgeharvest_bf16": _tc_edge_harvest,
+    }
+    if kernel in harvest:
         t = A.local_tile(A.rows, A.cols, A.vals, A.nnz)
         return _tc_combine(
-            jax.jit(_tc_edge_harvest, static_argnums=2)(
+            jax.jit(harvest[kernel], static_argnums=2)(
                 t.rows, t.cols, A.nrows
             )
         ) // 3

@@ -366,4 +366,5 @@ def test_triangle_count_edge_harvest_kernel(rng):
     A = SpParMat.from_dense(grid, d)
     want = triangle_count(A, kernel="sparse")
     assert triangle_count(A, kernel="edgeharvest") == want
+    assert triangle_count(A, kernel="edgeharvest_bf16") == want
     assert triangle_count(A, kernel="dense") == want
