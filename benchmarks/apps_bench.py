@@ -735,7 +735,8 @@ def bench_matching_device():
     while True:
         t0 = time.perf_counter()
         mate_row, mate_col, n_aug = _mcm_phase(AT, mate_row, mate_col)
-        aug = int(n_aug)  # the per-phase readback (poisons later phases)
+        aug = int(n_aug)  # per-phase readback (measured HARMLESS to
+        #                     later phases: 0.12-0.15 s each, PERF_NOTES_r5)
         phases.append({"s": round(time.perf_counter() - t0, 3),
                        "augmented": aug})
         if aug == 0:
@@ -812,7 +813,6 @@ def bench_awpm():
     mr, mc = awpm(A)
     card = int((np.asarray(mr.to_global()) >= 0).sum())
     dt = time.perf_counter() - t0
-    d = np.zeros((n, n), np.float32) if n <= 4096 else None
     out = {
         "metric": f"awpm_rmat_scale{SCALE}_s",
         "value": round(dt, 3),

@@ -143,13 +143,25 @@ def _tc_edge_harvest_bits(rows, cols, n: int, chunk: int = 8192) -> jax.Array:
     """
     nw = -(-n // 32)
     npad32 = nw * 32
+    # ON-DEVICE DEDUP (duplicate COO entries would double-add a bit,
+    # carrying into the NEXT bit and corrupting the adjacency — unlike
+    # the idempotent .set of the bf16 variant): stable two-key sort,
+    # mask repeats, zero their bit contribution AND their edge weight.
+    order_c = jnp.argsort(cols, stable=True)
+    r1, c1 = rows[order_c], cols[order_c]
+    order_r = jnp.argsort(r1, stable=True)
+    rows, cols = r1[order_r], c1[order_r]
+    dup = jnp.concatenate([
+        jnp.zeros((1,), bool),
+        (rows[1:] == rows[:-1]) & (cols[1:] == cols[:-1]),
+    ])
     loops = rows == cols
-    r_all = jnp.where(loops, npad32, rows)  # dropped by mode="drop"
+    r_all = jnp.where(loops | dup, npad32, rows)  # dropped (mode="drop")
     bits = jnp.zeros((npad32, nw), jnp.uint32)
     bits = bits.at[r_all, cols >> 5].add(
         (jnp.uint32(1) << (cols.astype(jnp.uint32) & 31)), mode="drop"
     )
-    keep = rows > cols
+    keep = (rows > cols) & ~dup
     nedge = rows.shape[0]
     epad = -(-nedge // chunk) * chunk
     er = jnp.pad(jnp.where(keep, rows, 0), (0, epad - nedge))
@@ -212,6 +224,15 @@ def triangle_count(A: SpParMat, kernel: str = "auto") -> int:
         "edgeharvest_bf16": _tc_edge_harvest,
     }
     if kernel in harvest:
+        cap = (
+            EDGE_HARVEST_BITS_MAX_DIM if kernel == "edgeharvest"
+            else EDGE_HARVEST_MAX_DIM
+        )
+        if max(A.nrows, A.ncols) > cap:
+            raise ValueError(
+                f"{kernel} needs the dense adjacency in HBM: "
+                f"n <= {cap}, got {max(A.nrows, A.ncols)}"
+            )
         t = A.local_tile(A.rows, A.cols, A.vals, A.nnz)
         return _tc_combine(
             jax.jit(harvest[kernel], static_argnums=2)(

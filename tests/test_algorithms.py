@@ -368,3 +368,31 @@ def test_triangle_count_edge_harvest_kernel(rng):
     assert triangle_count(A, kernel="edgeharvest") == want
     assert triangle_count(A, kernel="edgeharvest_bf16") == want
     assert triangle_count(A, kernel="dense") == want
+
+
+def test_triangle_count_edge_harvest_duplicates(rng):
+    """Bit-packed edge-harvest must survive duplicate COO entries (a
+    double-added bit would carry into the next bit and corrupt the
+    adjacency) — dedup happens on device."""
+    from combblas_tpu.models.tc import triangle_count
+
+    grid = Grid.make(1, 1)
+    n = 40
+    d = (rng.random((n, n)) < 0.3).astype(np.float32)
+    d = np.maximum(d, d.T)
+    np.fill_diagonal(d, 0.0)
+    r, c = np.nonzero(d)
+    # duplicate a third of the entries (and one entry three times)
+    dup = np.arange(0, len(r), 3)
+    r2 = np.concatenate([r, r[dup], r[:1], r[:1]])
+    c2 = np.concatenate([c, c[dup], c[:1], c[:1]])
+    A = SpParMat.from_global_coo(
+        grid, r2, c2, np.ones(len(r2), np.float32), n, n
+    )
+    want = triangle_count(
+        SpParMat.from_global_coo(
+            grid, r, c, np.ones(len(r), np.float32), n, n
+        ),
+        kernel="sparse",
+    )
+    assert triangle_count(A, kernel="edgeharvest") == want
