@@ -415,8 +415,30 @@ def validate_bfs_device(E, parents, levels):
     return out[0]
 
 
-@partial(jax.jit, static_argnames=("max_iters", "sr", "track_levels"))
 def bfs_batch(
+    A,
+    sources,
+    max_iters: int | None = None,
+    sr: "Semiring" = SELECT2ND_MAX,
+    track_levels: bool = True,
+):
+    """Eager wrapper over ``_bfs_batch_impl`` (plain-outputs law: a
+    dataclass-wrapped jit output tripled the batch child's wall time in
+    the r5 A/B — 90.8 vs 281.7 MTEPS; see probe_seq_r5 wa/wc)."""
+    from ..parallel.vec import DistMultiVec
+
+    p, l, niter = _bfs_batch_impl(
+        A, sources, max_iters=max_iters, sr=sr,
+        track_levels=track_levels,
+    )
+    mk = lambda b: DistMultiVec(
+        blocks=b, length=A.nrows, align="row", grid=A.grid
+    )
+    return mk(p), mk(l), niter
+
+
+@partial(jax.jit, static_argnames=("max_iters", "sr", "track_levels"))
+def _bfs_batch_impl(
     A,
     sources,
     max_iters: int | None = None,
@@ -433,8 +455,9 @@ def bfs_batch(
     fetch ~free; (b) the whole batch is one launch — one fixed ~100ms
     dispatch instead of W of them.
 
-    ``sources``: int32 [W]. Returns (parents DistMultiVec [n, W] row-aligned,
-    levels DistMultiVec, num_iters) — num_iters is the MAX level over the
+    ``sources``: int32 [W]. Returns (parents [pr, lr, W] int32 blocks,
+    levels blocks, num_iters) — PLAIN ARRAYS (the eager wrapper above
+    rebuilds the DistMultiVecs); num_iters is the MAX level over the
     batch (lanes that finish early idle through the remaining levels with
     no semantic effect; dense-regime level cost is frontier-independent).
     ``track_levels=False`` drops the level array from the loop carry,
@@ -495,7 +518,7 @@ def bfs_batch(
         # levels were not tracked: return discovery indicator (0 for the
         # sources / discovered? -1 undiscovered) — parents' sign carries it.
         levels = jnp.where(parents >= 0, 0, -1)
-    return mk(parents, "row"), mk(levels, "row"), niter
+    return parents, levels, niter
 
 
 @lru_cache(maxsize=None)
@@ -943,15 +966,35 @@ def batch_traversed_edges(deg_row_blocks, parents) -> jax.Array:
     return (te // 2).astype(jnp.int32)
 
 
+def bfs_batch_compact(A, sources, max_iters: int | None = None,
+                      ring: bool = False, csc=None,
+                      frontier_capacity: int | None = None,
+                      edge_capacity: int | None = None):
+    """Eager wrapper: the jitted program returns plain block arrays (the
+    plain-outputs law — DistVec/DistMultiVec dataclass wrapping inside
+    jit measured 60x slower on the target backend, probe_seq_r5 wa/wc);
+    this wrapper rebuilds the DistMultiVecs outside."""
+    from ..parallel.vec import DistMultiVec
+
+    p, l, niter = _bfs_batch_compact_impl(
+        A, sources, max_iters=max_iters, ring=ring, csc=csc,
+        frontier_capacity=frontier_capacity, edge_capacity=edge_capacity,
+    )
+    mk = lambda b: DistMultiVec(
+        blocks=b, length=A.nrows, align="row", grid=A.grid
+    )
+    return mk(p), mk(l), niter
+
+
 @partial(
     jax.jit,
     static_argnames=("max_iters", "ring", "frontier_capacity",
                      "edge_capacity"),
 )
-def bfs_batch_compact(A, sources, max_iters: int | None = None,
-                      ring: bool = False, csc=None,
-                      frontier_capacity: int | None = None,
-                      edge_capacity: int | None = None):
+def _bfs_batch_compact_impl(A, sources, max_iters: int | None = None,
+                            ring: bool = False, csc=None,
+                            frontier_capacity: int | None = None,
+                            edge_capacity: int | None = None):
     """Level-compressed multi-source BFS: int8 frontiers, parents
     reconstructed in ONE pass after the search.
 
@@ -981,8 +1024,9 @@ def bfs_batch_compact(A, sources, max_iters: int | None = None,
     are exactly this regime. ``lax.cond`` keeps both kernels compiled
     once; zero host readbacks.
 
-    Returns (parents DistMultiVec int32, levels DistMultiVec int8,
-    num_iters) with the same conventions as ``bfs_batch``.
+    Returns (parents int32 blocks, levels int8 blocks, num_iters) —
+    PLAIN ARRAYS (the eager wrapper above rebuilds the DistMultiVecs) —
+    with the same conventions as ``bfs_batch``.
     """
     from ..parallel.ellmat import (
         EllParMat,
@@ -1080,4 +1124,5 @@ def bfs_batch_compact(A, sources, max_iters: int | None = None,
     parents = jnp.where(
         (levels < 0) | (row_gids[:, :, None] < 0), -1, parents
     )
-    return mk(parents, "row"), mk(levels, "row"), niter.astype(jnp.int32)
+    # plain arrays out (see the eager wrapper above)
+    return parents, levels, niter.astype(jnp.int32)

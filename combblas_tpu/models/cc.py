@@ -36,13 +36,25 @@ from ..parallel.spmv import dist_spmv
 from ..parallel.vec import DistVec
 
 
-@jax.jit
 def connected_components(A: SpParMat) -> tuple[DistVec, jax.Array]:
+    """Eager wrapper over ``_connected_components_impl`` (plain-outputs
+    law, PERF_NOTES_r5 §1: dataclass-wrapped jit outputs ran the batched
+    BFS child 3x slower in the r5 A/B)."""
+    blocks, niter = _connected_components_impl(A)
+    return (
+        DistVec(blocks=blocks, length=A.nrows, align="row", grid=A.grid),
+        niter,
+    )
+
+
+@jax.jit
+def _connected_components_impl(A: SpParMat):
     """Component labels (min vertex id in each component) + iteration count.
 
     A is interpreted structurally (any nonzero = edge) and must be
-    symmetric; labels are a row-aligned int32 DistVec, padding slots carry
-    their own (out-of-range) ids and never interact with real vertices.
+    symmetric; returns PLAIN row-aligned int32 label BLOCKS (the eager
+    wrapper above rebuilds the DistVec); padding slots carry their own
+    (out-of-range) ids and never interact with real vertices.
     """
     grid = A.grid
     n = A.nrows
@@ -85,14 +97,23 @@ def connected_components(A: SpParMat) -> tuple[DistVec, jax.Array]:
         return gf.blocks, jnp.any(gf.blocks != fb)
 
     fb, _ = jax.lax.while_loop(jcond, jstep, (fb, jnp.bool_(True)))
-    return mk(fb), niter
+    return fb, niter
 
 
 _STAR, _NONSTAR, _CONVERGED = jnp.int32(1), jnp.int32(0), jnp.int32(2)
 
 
-@jax.jit
 def lacc(A: SpParMat) -> tuple[DistVec, jax.Array]:
+    """Eager wrapper over ``_lacc_impl`` (plain-outputs law)."""
+    blocks, niter = _lacc_impl(A)
+    return (
+        DistVec(blocks=blocks, length=A.nrows, align="row", grid=A.grid),
+        niter,
+    )
+
+
+@jax.jit
+def _lacc_impl(A: SpParMat):
     """LACC connected components (≈ Applications/CC.h:1035-1530,
     Azad-Buluç IPDPS'19): conditional star hooking, unconditional star
     hooking, shortcutting, and star detection, iterated until every vertex
@@ -249,7 +270,7 @@ def lacc(A: SpParMat) -> tuple[DistVec, jax.Array]:
     parent_b, _ = jax.lax.while_loop(
         jcond, jstep, (parent_b, jnp.bool_(True))
     )
-    return mk(parent_b), niter
+    return parent_b, niter
 
 
 def num_components(labels: DistVec) -> int:
