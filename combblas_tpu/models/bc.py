@@ -143,8 +143,16 @@ def betweenness_centrality(
     return acc
 
 
-@partial(jax.jit, static_argnames=("max_depth",))
 def bc_batch_dense(E, ET, sources, max_depth: int | None = None):
+    """Eager wrapper over ``_bc_batch_dense_impl`` (plain-outputs law)."""
+    total = _bc_batch_dense_impl(E, ET, sources, max_depth=max_depth)
+    return DistVec(
+        blocks=total, length=E.nrows, align="row", grid=E.grid
+    )
+
+
+@partial(jax.jit, static_argnames=("max_depth",))
+def _bc_batch_dense_impl(E, ET, sources, max_depth: int | None = None):
     """Batched Brandes in ONE compiled program over dense [n, W] state.
 
     The host-loop ``bc_batch`` mirrors the reference's
@@ -221,4 +229,4 @@ def bc_batch_dense(E, ET, sources, max_depth: int | None = None):
     # endpoints excluded: zero each lane's own source slot, sum lanes
     delta = jnp.where(is_src, 0, delta)
     total = jnp.sum(delta, axis=-1)
-    return DistVec(blocks=total, length=n, align="row", grid=grid)
+    return total

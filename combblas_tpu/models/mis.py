@@ -24,8 +24,17 @@ from ..parallel.vec import DistVec
 UNDECIDED, IN_SET, EXCLUDED = 0, 1, -1
 
 
-@jax.jit
 def mis(A: SpParMat, key: jax.Array) -> tuple[DistVec, jax.Array]:
+    """Eager wrapper over ``_mis_impl`` (plain-outputs law)."""
+    blocks, niter = _mis_impl(A, key)
+    return (
+        DistVec(blocks=blocks, length=A.nrows, align="row", grid=A.grid),
+        niter,
+    )
+
+
+@jax.jit
+def _mis_impl(A: SpParMat, key: jax.Array):
     """Maximal independent set of the symmetric loop-free graph A.
 
     Returns (status row-aligned int32: 1 = in set, -1 = excluded,
@@ -66,4 +75,4 @@ def mis(A: SpParMat, key: jax.Array) -> tuple[DistVec, jax.Array]:
         return sb, it + 1
 
     sb, niter = jax.lax.while_loop(cond, step, (status0, jnp.int32(0)))
-    return mk(sb), niter
+    return sb, niter

@@ -25,17 +25,29 @@ def _scale(a, s):
     return a * s
 
 
+def pagerank(A, alpha=0.85, tol=1e-6, max_iters=100):
+    """Eager wrapper over ``_pagerank_impl`` (plain-outputs law)."""
+    blocks, niter = _pagerank_impl(
+        A, alpha=alpha, tol=tol, max_iters=max_iters
+    )
+    return (
+        DistVec(blocks=blocks, length=A.nrows, align="row", grid=A.grid),
+        niter,
+    )
+
+
 @partial(jax.jit, static_argnames=("alpha", "tol", "max_iters"))
-def pagerank(
+def _pagerank_impl(
     A: SpParMat,
     alpha: float = 0.85,
     tol: float = 1e-6,
     max_iters: int = 100,
-) -> tuple[DistVec, jax.Array]:
+):
     """Ranks over the column-stochastic normalization of A.
 
-    A[i, j] != 0 means edge j -> i (j links to i). Returns (row-aligned
-    float32 ranks summing to 1, iterations).
+    A[i, j] != 0 means edge j -> i (j links to i). Returns PLAIN
+    (row-aligned float32 rank blocks summing to 1, iterations) — the
+    eager wrapper above rebuilds the DistVec (plain-outputs law).
     """
     grid = A.grid
     n = A.nrows
@@ -78,11 +90,29 @@ def pagerank(
     xb, _, niter = jax.lax.while_loop(
         cond, step, (x0, jnp.float32(jnp.inf), jnp.int32(0))
     )
-    return mk_row(xb), niter
+    return xb, niter
+
+
+def pagerank_batch(P_ell, sources, dangling, alpha=0.85, tol=1e-6,
+                   max_iters=100):
+    """Eager wrapper over ``_pagerank_batch_impl`` (plain-outputs law)."""
+    from ..parallel.vec import DistMultiVec
+
+    blocks, niter = _pagerank_batch_impl(
+        P_ell, sources, dangling, alpha=alpha, tol=tol,
+        max_iters=max_iters,
+    )
+    return (
+        DistMultiVec(
+            blocks=blocks, length=P_ell.nrows, align="row",
+            grid=P_ell.grid,
+        ),
+        niter,
+    )
 
 
 @partial(jax.jit, static_argnames=("alpha", "tol", "max_iters"))
-def pagerank_batch(
+def _pagerank_batch_impl(
     P_ell,
     sources: jax.Array,
     dangling: "DistVec",
@@ -139,4 +169,4 @@ def pagerank_batch(
     xb, _, niter = jax.lax.while_loop(
         cond, step, (e_s, jnp.float32(jnp.inf), jnp.int32(0))
     )
-    return mk(xb), niter
+    return xb, niter

@@ -18,8 +18,18 @@ from ..parallel.spmv import dist_spmv
 from ..parallel.vec import DistVec
 
 
-@jax.jit
 def sssp(A: SpParMat, source) -> tuple[DistVec, jax.Array]:
+    """Eager wrapper over ``_sssp_impl`` (plain-outputs law,
+    PERF_NOTES_r5 §1)."""
+    blocks, niter = _sssp_impl(A, source)
+    return (
+        DistVec(blocks=blocks, length=A.nrows, align="row", grid=A.grid),
+        niter,
+    )
+
+
+@jax.jit
+def _sssp_impl(A: SpParMat, source):
     """Distances from ``source``; unreachable vertices hold +inf.
 
     A[i, j] = w is the weight of edge j -> i (same gather orientation as
@@ -52,15 +62,28 @@ def sssp(A: SpParMat, source) -> tuple[DistVec, jax.Array]:
     db, _, niter = jax.lax.while_loop(
         cond, step, (d0, jnp.bool_(True), jnp.int32(0))
     )
-    return mk(db), niter
+    return db, niter
+
+
+def sssp_batch(E, sources):
+    """Eager wrapper over ``_sssp_batch_impl`` (plain-outputs law)."""
+    from ..parallel.vec import DistMultiVec
+
+    blocks, niter = _sssp_batch_impl(E, sources)
+    return (
+        DistMultiVec(
+            blocks=blocks, length=E.nrows, align="row", grid=E.grid
+        ),
+        niter,
+    )
 
 
 @jax.jit
-def sssp_batch(E, sources):
+def _sssp_batch_impl(E, sources):
     """Multi-source Bellman-Ford: distances from W sources in ONE program.
 
     ``E``: weighted EllParMat (entry (i,j) = w(j->i), non-negative).
-    ``sources``: [W] int32. Returns (row-aligned DistMultiVec [n, W] of
+    ``sources``: [W] int32. Returns (row-aligned PLAIN [pr, lr, W] blocks (wrapper rebuilds the DistMultiVec) of
     distances — +inf where unreachable — and the iteration count).
 
     The multi-root amortization of the batched BFS applied to SSSP: the
@@ -99,4 +122,4 @@ def sssp_batch(E, sources):
     db, _, niter = jax.lax.while_loop(
         cond, step, (d0, jnp.bool_(True), jnp.int32(0))
     )
-    return mk(db), niter
+    return db, niter
