@@ -48,7 +48,21 @@ def main():
     data = np.load(os.environ["BENCH_GRAPH_NPZ"])
     from bench import _load_structures
 
-    E, csc = _load_structures(grid, data, n)
+    if os.environ.get("PROBE_LADDER"):
+        from combblas_tpu.parallel.ellmat import EllParMat
+
+        E = EllParMat.from_host_coo(
+            grid, data["rows"], data["cols"],
+            np.zeros(len(data["rows"]), np.int8), n, n,
+            ladder=os.environ["PROBE_LADDER"],
+        )
+        from combblas_tpu.parallel.ellmat import upload_csc_companion
+
+        csc = upload_csc_companion(
+            grid, data["csc_indptr"], data["csc_rowidx"]
+        )
+    else:
+        E, csc = _load_structures(grid, data, n)
     lr = grid.local_rows(n)
     lc = grid.local_cols(n)
     nb = len(E.buckets)
