@@ -173,6 +173,34 @@ def _enable_compile_cache():
     enable_compile_cache()
 
 
+def _obs_setup(tag: str) -> str | None:
+    """BENCH_OBS=1: enable the structured telemetry subsystem in this
+    process (combblas_tpu.obs; docs/observability.md) with a per-process
+    JSONL sidecar — spans for the load/warmup/timed phases, compile-cache
+    hit/miss counters, kernel dispatch counts. The official stdout JSON
+    protocol is unchanged; each child reports its sidecar path under
+    "obs_jsonl" and the parent merges them (the multihost-style
+    per-process-files-merged-host-side aggregation path).
+
+    DEVICE_SYNC stays OFF here: obs must never add a readback to a timed
+    child on this chip (bench.py module docstring)."""
+    from combblas_tpu import obs
+
+    return obs.enable_sidecar(tag)
+
+
+def _obs_dump(out: dict) -> None:
+    """Dump this process's telemetry sidecar (if enabled) and reference
+    it in the child's JSON line."""
+    from combblas_tpu import obs
+
+    if obs.ENABLED:
+        try:
+            out["obs_jsonl"] = obs.dump_jsonl()
+        except Exception as e:  # telemetry must never fail the bench
+            out["obs_error"] = str(e)
+
+
 def build_graph_npz(path: str) -> float:
     """Kernel 1, host path: R-MAT generate + symmetricize + dedup; returns
     construction seconds (graph build only; the search structures are
@@ -247,6 +275,7 @@ def k1_device_child(path: str):
     the official construction_s the distributed pipeline's number
     (SpParMat.cpp:3140-3441 role) instead of the host numpy path."""
     _enable_compile_cache()
+    _obs_setup("k1")
     import jax
     import numpy as np
 
@@ -304,11 +333,13 @@ def k1_device_child(path: str):
         deg=deg.astype(np.int32),
         roots=roots.astype(np.int32),
     )
-    print(json.dumps({
+    out = {
         "construction_s": round(construction_s, 2),
         "stages": {k: round(v, 3) for k, v in timings.items()},
         "nnz": int(len(rows_u)),
-    }))
+    }
+    _obs_dump(out)
+    print(json.dumps(out))
 
 
 def _load_structures(grid, data, n, want_csc=True):
@@ -358,9 +389,11 @@ def seq_child(graph_path: str, seq_idx: int):
     """Sequential-statistic child: ONE root, frontier-proportional
     tiered BFS (bfs_single), one launch, own process."""
     _enable_compile_cache()
+    _obs_setup(f"seq{seq_idx}")
     import jax
     import numpy as np
 
+    from combblas_tpu import obs
     from combblas_tpu.models.bfs import bfs_single, single_traversed_edges
     from combblas_tpu.parallel.grid import Grid
     from combblas_tpu.parallel.vec import DistVec
@@ -369,34 +402,58 @@ def seq_child(graph_path: str, seq_idx: int):
     n = 1 << SCALE
 
     t0 = time.perf_counter()
-    data = np.load(graph_path)
-    root = np.int32(data["roots"][seq_idx])
-    E, csc = _load_structures(grid, data, n)
-    deg_blocks = DistVec.from_global(grid, data["deg"], align="row").blocks
-    # symmetric graph: per-column degrees == per-row degrees; host-built
-    # (deriving them from the CSC indptr on device hits the chip's
-    # pathological megascale-1-D path, probe_seq_r5 mode v6)
-    coldeg_blocks = DistVec.from_global(grid, data["deg"], align="col").blocks
+    with obs.span("bench.load"):
+        data = np.load(graph_path)
+        root = np.int32(data["roots"][seq_idx])
+        E, csc = _load_structures(grid, data, n)
+        deg_blocks = DistVec.from_global(
+            grid, data["deg"], align="row"
+        ).blocks
+        # symmetric graph: per-column degrees == per-row degrees;
+        # host-built (deriving them from the CSC indptr on device hits the
+        # chip's pathological megascale-1-D path, probe_seq_r5 mode v6)
+        coldeg_blocks = DistVec.from_global(
+            grid, data["deg"], align="col"
+        ).blocks
     from combblas_tpu.models.bfs import parse_tier_spec
 
     tiers = parse_tier_spec(SEQ_TIERS)
     construction_child_s = time.perf_counter() - t0
 
+    # csr=csc REUSE CONTRACT (ADVICE r5): bfs_single's "bu" tiers walk the
+    # CSR companion, and reusing the CSC there is correct ONLY because
+    # (a) the Graph500 graph is SYMMETRIZED — in-edges equal out-edges, so
+    # the column-major companion doubles as the row-major one — and
+    # (b) the grid is 1x1, so build_csr_companion's per-tile layout
+    # degenerates to the same single global array. An asymmetric graph or
+    # a multi-chip grid must build the real companion
+    # (ellmat.build_csr_companion / a csr twin in
+    # augment_npz_with_structures) — fail loudly rather than traverse
+    # wrong in-edges.
+    assert grid.pr == 1 and grid.pc == 1, (
+        "seq_child reuses csr=csc, valid only on a 1x1 grid with a "
+        "symmetrized graph; build the real CSR companion for "
+        f"{grid.pr}x{grid.pc}"
+    )
+
     # warmup (compile via the persistent cache + one full execution)
     t0 = time.perf_counter()
-    p, _, _ = bfs_single(E, root, csc, csr=csc, tiers=tiers,
-                         coldeg=coldeg_blocks, rowdeg=deg_blocks)
-    te_dev = single_traversed_edges(deg_blocks, p)
-    jax.block_until_ready(te_dev)
+    with obs.span("bench.warmup"):
+        p, _, _ = bfs_single(E, root, csc, csr=csc, tiers=tiers,
+                             coldeg=coldeg_blocks, rowdeg=deg_blocks)
+        te_dev = single_traversed_edges(deg_blocks, p)
+        jax.block_until_ready(te_dev)
     warmup_s = time.perf_counter() - t0
     time.sleep(SEQ_DRAIN_S)
 
     t0 = time.perf_counter()
-    p, l, niter = bfs_single(E, root, csc, csr=csc, tiers=tiers,
-                             coldeg=coldeg_blocks, rowdeg=deg_blocks)
-    te_dev = single_traversed_edges(deg_blocks, p)
-    te = int(np.asarray(jax.device_get(te_dev)))  # true barrier
+    with obs.span("bench.timed", root_index=int(seq_idx)):
+        p, l, niter = bfs_single(E, root, csc, csr=csc, tiers=tiers,
+                                 coldeg=coldeg_blocks, rowdeg=deg_blocks)
+        te_dev = single_traversed_edges(deg_blocks, p)
+        te = int(np.asarray(jax.device_get(te_dev)))  # true barrier
     dt = time.perf_counter() - t0
+    obs.span_event("bfs.result", traversed_edges=te, root_index=int(seq_idx))
 
     out = {
         "mteps": round(te / dt / 1e6, 4),
@@ -431,13 +488,17 @@ def seq_child(graph_path: str, seq_idx: int):
             "tree_edge_bad": int(v[2].sum()),
             "edge_consistency_bad": int(v[3].sum()),
         }
+    _obs_dump(out)
     print(json.dumps(out), flush=True)
 
 
 def child(graph_path: str):
     _enable_compile_cache()
+    _obs_setup("batch")
     import jax
     import numpy as np
+
+    from combblas_tpu import obs
 
     from combblas_tpu.models.bfs import batch_traversed_edges, bfs_batch_compact
     from combblas_tpu.parallel.grid import Grid
@@ -448,20 +509,21 @@ def child(graph_path: str):
 
     # --- Phase 1+2: host-only load, then upload (H2D only) ----------------
     t0 = time.perf_counter()
-    data = np.load(graph_path)
-    deg, roots = data["deg"], data["roots"]
-    nnz = (
-        int(data["nnz"]) if "nnz" in data else len(data["rows"])
-    )
-    E, csc_arrays = _load_structures(grid, data, n, want_csc=DIROPT)
-    csc = None
-    fcap = ecap = None
-    if DIROPT:
-        csc = csc_arrays
-        fcap = grid.local_cols(n) // 8
-        ecap = max(nnz // 16, 1 << 20)
-    deg_blocks = DistVec.from_global(grid, deg, align="row").blocks
-    roots_dev = jax.device_put(np.asarray(roots, np.int32))
+    with obs.span("bench.load"):
+        data = np.load(graph_path)
+        deg, roots = data["deg"], data["roots"]
+        nnz = (
+            int(data["nnz"]) if "nnz" in data else len(data["rows"])
+        )
+        E, csc_arrays = _load_structures(grid, data, n, want_csc=DIROPT)
+        csc = None
+        fcap = ecap = None
+        if DIROPT:
+            csc = csc_arrays
+            fcap = grid.local_cols(n) // 8
+            ecap = max(nnz // 16, 1 << 20)
+        deg_blocks = DistVec.from_global(grid, deg, align="row").blocks
+        roots_dev = jax.device_put(np.asarray(roots, np.int32))
     construction_child_s = time.perf_counter() - t0
 
     # --- Phase 3: ONE timed launch ----------------------------------------
@@ -470,20 +532,22 @@ def child(graph_path: str):
     # the drain sleep must cover the warmup EXECUTION (~20-30 s at the
     # operating point), not just dispatch — hence DRAIN_S=45 default.
     t0 = time.perf_counter()
-    p, _, _ = bfs_batch_compact(
-        E, roots_dev, csc=csc, frontier_capacity=fcap, edge_capacity=ecap
-    )
-    te_dev = batch_traversed_edges(deg_blocks, p)
-    jax.block_until_ready(te_dev)
+    with obs.span("bench.warmup"):
+        p, _, _ = bfs_batch_compact(
+            E, roots_dev, csc=csc, frontier_capacity=fcap, edge_capacity=ecap
+        )
+        te_dev = batch_traversed_edges(deg_blocks, p)
+        jax.block_until_ready(te_dev)
     warmup_s = time.perf_counter() - t0
     time.sleep(DRAIN_S)
 
     t0 = time.perf_counter()
-    parents, levels, _ = bfs_batch_compact(
-        E, roots_dev, csc=csc, frontier_capacity=fcap, edge_capacity=ecap
-    )
-    te_dev = batch_traversed_edges(deg_blocks, parents)
-    te = np.asarray(jax.device_get(te_dev))  # true barrier (poisons after)
+    with obs.span("bench.timed", roots=int(len(roots))):
+        parents, levels, _ = bfs_batch_compact(
+            E, roots_dev, csc=csc, frontier_capacity=fcap, edge_capacity=ecap
+        )
+        te_dev = batch_traversed_edges(deg_blocks, parents)
+        te = np.asarray(jax.device_get(te_dev))  # true barrier (poisons after)
     dt = time.perf_counter() - t0
 
     validation = None
@@ -550,6 +614,7 @@ def child(graph_path: str):
             f"{mteps:.1f} MTEPS is >2x below the recorded operating point "
             f"({OPERATING_MTEPS}); suspect drain/compile-cache/chip state"
         )
+    _obs_dump(out)
     print(json.dumps(out), flush=True)
 
 
@@ -766,6 +831,7 @@ def main():
                 "mteps": warm.get("mteps"),
                 "warmup_s": warm.get("warmup_s"),
                 "wall_s": round(est, 1),
+                "obs_jsonl": warm.get("obs_jsonl"),
             }
             est = max(est * 0.7, 45.0)  # timed children run warm
         for i in range(min(SEQ_ROOTS, NROOTS)):
@@ -780,6 +846,35 @@ def main():
             )
             est = time.perf_counter() - t0
             emit(runs, seq_runs, construction_s, k1_info, t_start)
+        if os.environ.get("BENCH_OBS") == "1":
+            # merge the children's per-process telemetry sidecars into one
+            # trace (the multihost aggregation path, host-side) and
+            # re-emit the official line referencing it
+            from combblas_tpu import obs
+
+            # every obs-wired child: batch runs, seq roots, the k1 device
+            # child (k1_info IS its JSON line), and the untimed warmup
+            sources = runs + seq_runs + [
+                k1_info, k1_info.get("seq_warmup_child") or {},
+            ]
+            sidecars = [
+                r["obs_jsonl"] for r in sources
+                if r.get("obs_jsonl") and os.path.exists(r["obs_jsonl"])
+            ]
+            if sidecars:
+                merged_path = os.environ.get(
+                    "BENCH_OBS_OUT", "obs_trace.jsonl"
+                )
+                try:
+                    agg = obs.merge_jsonl_files(sidecars, merged_path)
+                    k1_info["obs"] = {
+                        "merged_jsonl": merged_path,
+                        "children": len(sidecars),
+                        "counters": agg["counters"],
+                    }
+                except Exception as e:
+                    k1_info["obs"] = {"error": str(e)}
+                emit(runs, seq_runs, construction_s, k1_info, t_start)
         if not seq_runs:
             # never leave the artifact without the final (identical) line
             emit(runs, seq_runs, construction_s, k1_info, t_start)

@@ -559,6 +559,11 @@ def bench_mcl_dense():
     K = ITERS
     SELECT = int(os.environ.get("BENCH_SELECT", "64"))
     MODE = os.environ.get("BENCH_DENSE_MODE", "bf16x3")
+    # EXPLICIT opt-in to plateau detect-and-perturb (the library default
+    # is now 0 — kicks can move boundary vertices between clusters, so
+    # only the driver turns them on; ADVICE r5). 5e-5 is the round-5
+    # operating point; kicks are counted in the artifact.
+    PERTURB = float(os.environ.get("BENCH_MCL_PERTURB", "5e-5"))
     r, c, n = _graph(SCALE, ef=8)
     grid = Grid.make(1, 1)
     diag = np.arange(n, dtype=np.int64)
@@ -572,7 +577,7 @@ def bench_mcl_dense():
         n, n, 2.0, 1e-3, K,
         hard=1e-4, select=min(SELECT, n),
         recover=min(SELECT + SELECT // 4, n),
-        rpct=0.9, mode=MODE,
+        rpct=0.9, mode=MODE, perturb_delta=PERTURB,
     )
     rows, cols, vals = A.rows[0, 0], A.cols[0, 0], A.vals[0, 0]
     compiled = jax.jit(run).lower(rows, cols, vals).compile()
@@ -583,6 +588,15 @@ def bench_mcl_dense():
     dt = time.perf_counter() - t0
     ch_v = float(jax.device_get(ch))
     hist_v = np.asarray(jax.device_get(hist))[:iters]
+    kicks = int(jax.device_get(npert))
+    from combblas_tpu import obs
+
+    if obs.ENABLED:  # perturbation kicks as span events (ADVICE r5)
+        obs.span_event(
+            "mcl.perturb", kicks=kicks, delta=PERTURB, iters=iters,
+            chaos=round(ch_v, 6),
+        )
+        obs.count("mcl.perturb_kicks", kicks)
     print(
         json.dumps(
             {
@@ -596,7 +610,8 @@ def bench_mcl_dense():
                 "chaos": round(ch_v, 6),
                 "chaos_trajectory": [round(float(x), 5) for x in hist_v],
                 "overflow": 0,
-                "perturbations": int(jax.device_get(npert)),
+                "perturbations": kicks,
+                "perturb_delta": PERTURB,
                 "select": SELECT,
                 "mode": MODE,
             }
@@ -832,7 +847,24 @@ def bench_awpm():
     print(json.dumps(out))
 
 
+def _obs_setup():
+    """BENCH_OBS=1: structured telemetry sidecar for this app process
+    (spans + counters -> JSONL; path printed to stderr so the stdout
+    JSON-line protocol stays parseable). See docs/observability.md."""
+    from combblas_tpu import obs
+
+    return obs.enable_sidecar(APP)
+
+
+def _obs_finish():
+    from combblas_tpu import obs
+
+    if obs.ENABLED:
+        print(f"[obs] {obs.dump_jsonl()}", file=sys.stderr, flush=True)
+
+
 if __name__ == "__main__":
+    _obs_setup()
     if APP == "pagerank":
         bench_pagerank()
     elif APP == "ppr":
@@ -867,3 +899,4 @@ if __name__ == "__main__":
         bench_tc_dense()
     else:
         raise SystemExit(f"unknown BENCH_APP {APP}")
+    _obs_finish()

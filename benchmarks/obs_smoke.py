@@ -1,0 +1,125 @@
+"""Smallest obs-wired bench entrypoint: exercise every instrumented hot
+path on a tiny R-MAT graph and write one schema-versioned JSONL trace.
+
+    JAX_PLATFORMS=cpu python benchmarks/obs_smoke.py [out.jsonl]
+
+The trace contains, end to end (docs/observability.md has the schema):
+
+  * per-hop BFS spans with ``frontier`` nnz events
+    (``models/bfs.py:bfs_levels_instrumented``),
+  * SpGEMM symbolic + realized fill-in counters and the per-tile
+    LoadImbalance gauge (``parallel/spgemm.py``),
+  * redistribute drop counts / retry counters
+    (``parallel/redistribute.py:from_device_coo``),
+  * compile-cache hit/miss counters (the jax.monitoring bridge; a tiny
+    probe program is compiled, evicted from the in-process jit cache,
+    and recompiled so the persistent cache registers a genuine hit),
+  * kernel dispatch/trace counters (``spmv.dispatch``, ``trace.*``) and
+    the BFS lru-cache gauges.
+
+tests/test_obs.py runs this in-process (2x2 grid under the 8-virtual-
+device fixture) and validates the file against the documented schema —
+the acceptance gate for the telemetry subsystem. ``DEVICE_SYNC`` is on
+here (realized-fill-in metrics need readbacks): this entrypoint is a
+CPU/diagnostic tool, never part of a timed chip protocol.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+SCALE = int(os.environ.get("BENCH_SCALE", "8"))
+EDGEFACTOR = int(os.environ.get("BENCH_EDGEFACTOR", "8"))
+
+
+def run(scale: int = SCALE, edgefactor: int = EDGEFACTOR,
+        out_path: str | None = None, grid_shape=(1, 1),
+        cache_dir: str | None = None) -> str:
+    """Run the instrumented pipeline; returns the JSONL path."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from combblas_tpu import obs
+    from combblas_tpu.models.bfs import bfs_levels_instrumented
+    from combblas_tpu.parallel.grid import Grid
+    from combblas_tpu.parallel.redistribute import from_device_coo
+    from combblas_tpu.parallel.spgemm import spgemm_scan
+    from combblas_tpu.semiring import PLUS_TIMES, SELECT2ND_MAX
+    from combblas_tpu.utils.compile_cache import enable_compile_cache
+    from combblas_tpu.utils.rmat import rmat_symmetric_coo_host
+
+    if out_path is None:
+        out_path = os.path.join(tempfile.gettempdir(), "obs_smoke.jsonl")
+    obs.enable(jsonl_path=out_path, device_sync=True)
+
+    # persistent compile cache into a scratch dir so cache hit/miss
+    # events fire without touching the repo's .jax_cache
+    enable_compile_cache(
+        cache_dir or tempfile.mkdtemp(prefix="obs_smoke_cache_")
+    )
+
+    with obs.span("obs_smoke", scale=scale, edgefactor=edgefactor):
+        # compile-cache probe: compile, drop the in-process executable,
+        # recompile — the second compile is a persistent-cache HIT
+        probe = jax.jit(lambda v: (v * 2 + 1).sum())
+        float(probe(jnp.arange(64.0)))
+        jax.clear_caches()
+        float(probe(jnp.arange(64.0)))
+
+        # kernel 1 (host generate + device route): redistribute counters
+        n = 1 << scale
+        rows, cols = rmat_symmetric_coo_host(42, scale, edgefactor)
+        key = rows.astype(np.int64) * n + cols
+        uniq = np.unique(key)
+        rows_u = (uniq // n).astype(np.int32)
+        cols_u = (uniq % n).astype(np.int32)
+        grid = Grid.make(*grid_shape)
+        ndev = grid.pr * grid.pc
+        chunk = -(-len(rows_u) // ndev)
+        pad = chunk * ndev - len(rows_u)
+        r3 = np.concatenate([rows_u, np.full(pad, n, np.int32)])
+        c3 = np.concatenate([cols_u, np.full(pad, n, np.int32)])
+        shape = (grid.pr, grid.pc, chunk)
+        rdev = jax.device_put(r3.reshape(shape), grid.tile_sharding())
+        cdev = jax.device_put(c3.reshape(shape), grid.tile_sharding())
+        vdev = jnp.ones(shape, jnp.float32)
+        A = from_device_coo(grid, rdev, cdev, vdev, n, n, slack=2.0)
+
+        # SpGEMM (A²): symbolic/realized fill-in + load imbalance
+        with obs.span("smoke.spgemm"):
+            spgemm_scan(PLUS_TIMES, A, A)
+
+        # per-hop instrumented BFS from the first non-isolated vertex
+        deg = np.bincount(rows_u, minlength=n)
+        source = int(np.flatnonzero(deg > 0)[0])
+        with obs.span("smoke.bfs"):
+            parents, levels, niter = bfs_levels_instrumented(
+                A, source, sr=SELECT2ND_MAX
+            )
+        ndisc = int(jnp.sum(parents.blocks >= 0))
+        obs.span_event(
+            "bfs.result", source=source, levels=int(niter),
+            discovered=ndisc,
+        )
+        obs.gauge("smoke.nnz", int(len(rows_u)))
+    return obs.dump_jsonl()
+
+
+def main():
+    out = run(out_path=sys.argv[1] if len(sys.argv) > 1 else None)
+    from combblas_tpu import obs
+
+    print(f"wrote {out}")
+    obs.print_report()
+    for rec in obs.metrics_snapshot():
+        if rec["kind"] == "counter":
+            print(f"  {rec['name']}{rec['labels'] or ''} = {rec['value']}")
+
+
+if __name__ == "__main__":
+    main()

@@ -18,6 +18,8 @@ Layer map (mirrors SURVEY.md §1, re-designed for JAX/XLA):
                    profiling timers, checkpointing.
 """
 
+from . import _compat  # jax.shard_map adapter for older runtimes (first!)
+
 from .semiring import (
     MAX_MIN,
     MIN_PLUS,
@@ -55,6 +57,10 @@ from .parallel.vec import DistMultiVec, concatenate
 from .parallel.indexing import spasgn, subsref
 from .semantic import SemanticGraph, filtered_bfs, filtered_mis
 
+# Telemetry (metrics registry + span traces + JSONL export); see
+# docs/observability.md. Zero-cost when disabled (the default).
+from . import obs
+
 __version__ = "0.1.0"
 
 __all__ = [
@@ -74,4 +80,6 @@ __all__ = [
     "concatenate", "DistMultiVec",
     # semantic graphs
     "SemanticGraph", "filtered_bfs", "filtered_mis",
+    # telemetry
+    "obs",
 ]

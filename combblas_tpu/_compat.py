@@ -1,0 +1,51 @@
+"""JAX version compatibility gates.
+
+The codebase targets the modern public API (``jax.shard_map`` with the
+``check_vma`` kwarg). Older runtimes (<= 0.4.x, like the baked CPU test
+image) only ship ``jax.experimental.shard_map.shard_map`` with the
+``check_rep`` spelling. Rather than sprinkling try/except over every
+call site, this module installs a thin adapter under ``jax.shard_map``
+once, at package import — semantics are identical (``check_vma`` maps to
+``check_rep``; both disable the replication/varying-manual-axes check).
+
+No-op on runtimes that already provide ``jax.shard_map``.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def install() -> None:
+    if not hasattr(jax, "shard_map"):
+        try:
+            from jax.experimental.shard_map import shard_map as _shard_map
+        except ImportError:  # nothing to adapt to; call sites fail loudly
+            _shard_map = None
+        if _shard_map is not None:
+
+            def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None,
+                          **kw):
+                if check_vma is not None and "check_rep" not in kw:
+                    kw["check_rep"] = check_vma
+                return _shard_map(
+                    f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw
+                )
+
+            jax.shard_map = shard_map
+
+    # Pallas-TPU params dataclass: renamed TPUCompilerParams (old) ->
+    # CompilerParams (new); the kwargs we use (vmem_limit_bytes,
+    # dimension_semantics) exist under both names.
+    try:
+        from jax.experimental.pallas import tpu as pltpu
+
+        if not hasattr(pltpu, "CompilerParams") and hasattr(
+            pltpu, "TPUCompilerParams"
+        ):
+            pltpu.CompilerParams = pltpu.TPUCompilerParams
+    except ImportError:
+        pass
+
+
+install()

@@ -26,6 +26,7 @@ from functools import lru_cache, partial
 import jax
 import jax.numpy as jnp
 
+from .. import obs
 from ..semiring import PLUS_TIMES, SELECT2ND_MAX
 from ..parallel.spmat import SpParMat, ones_i32
 from ..parallel.spmv import dist_spmspv_masked, dist_spmv_masked
@@ -96,6 +97,79 @@ def bfs(
         cond, step, (parents0, levels0, x0, jnp.int32(0), jnp.bool_(True))
     )
     return mk_row(parents), mk_row(levels), niter
+
+
+@partial(jax.jit, static_argnames=("sr",))
+def _bfs_level_step(sr, A, parents, levels, x, row_gids, level):
+    """ONE level of the dense-frontier BFS as its own jitted program —
+    the host-stepped unit ``bfs_levels_instrumented`` drives. Returns
+    (parents, levels, x_next, new-vertex count)."""
+    grid = A.grid
+    n = A.nrows
+    unvisited = DistVec(blocks=parents < 0, length=n, align="row", grid=grid)
+    xv = DistVec(blocks=x, length=A.ncols, align="col", grid=grid)
+    y = dist_spmv_masked(sr, A, xv, unvisited)
+    new = (y.blocks >= 0) & (parents < 0) & (row_gids >= 0)
+    parents = jnp.where(new, y.blocks, parents)
+    levels = jnp.where(new, level + 1, levels)
+    frontier_row = DistVec(
+        blocks=jnp.where(new, row_gids, -1), length=n, align="row", grid=grid,
+    )
+    x_next = frontier_row.realign("col").blocks
+    return parents, levels, x_next, jnp.sum(new).astype(jnp.int32)
+
+
+def bfs_levels_instrumented(
+    A,
+    source,
+    max_iters: int | None = None,
+    sr: "Semiring" = SELECT2ND_MAX,
+):
+    """Host-stepped level-synchronous BFS with one ``obs`` span PER HOP,
+    each carrying a ``frontier`` event with the discovered-vertex count —
+    the per-iteration table of the reference's TIMING builds
+    (``TopDownBFS.cpp:472-479``), structured.
+
+    DEBUG/OBSERVABILITY TOOL, not the benchmark kernel: every level pays
+    a device→host sync for the frontier count (which also terminates the
+    loop), exactly what the one-launch kernels (``bfs``, ``bfs_single``,
+    ``bfs_batch``) exist to avoid on readback-poisoned hardware. Use it
+    on CPU, in tests, or in a throwaway diagnostic process; the spans
+    line up with ``jax.profiler`` traces via their TraceAnnotations.
+
+    Works for SpParMat and EllParMat (``dist_spmv_masked`` dispatches).
+    Returns (parents, levels, num_levels) like ``bfs``.
+    """
+    grid = A.grid
+    n = A.nrows
+    pr_, lr = grid.pr, grid.local_rows(n)
+    pc_, lc = grid.pc, grid.local_cols(A.ncols)
+    iters = max_iters if max_iters is not None else n
+
+    row_gids = _global_ids(grid, pr_, lr, n, "row")
+    col_gids = _global_ids(grid, pc_, lc, A.ncols, "col")
+    parents = jnp.where(row_gids == source, jnp.int32(source), -1)
+    levels = jnp.where(row_gids == source, 0, -1).astype(jnp.int32)
+    x = jnp.where(col_gids == source, jnp.int32(source), -1)
+
+    niter = 0
+    with obs.span("bfs", source=int(source), nrows=int(n)):
+        for hop in range(iters):
+            with obs.span("bfs.hop", hop=hop):
+                parents, levels, x, nnew = _bfs_level_step(
+                    sr, A, parents, levels, x, row_gids, jnp.int32(hop)
+                )
+                frontier_nnz = int(nnew)  # the level's host sync
+                obs.span_event(
+                    "frontier", hop=hop + 1, nnz=frontier_nnz
+                )
+            # executed-iteration count, matching ``bfs``'s while_loop
+            # semantics (the terminal empty level is counted too)
+            niter = hop + 1
+            if frontier_nnz == 0:
+                break
+    mk = lambda b: DistVec(blocks=b, length=n, align="row", grid=grid)
+    return mk(parents), mk(levels), niter
 
 
 @partial(jax.jit, static_argnames=("frontier_capacity", "exp_capacity"))
@@ -521,11 +595,19 @@ def _bfs_batch_impl(
     return parents, levels, niter
 
 
-@lru_cache(maxsize=None)
+@lru_cache(maxsize=16)
 def _gid_blocks(grid, nblocks: int, block_len: int, length: int,
                 align: str):
     """Materialized global-id blocks (``_global_ids`` as a DEVICE BUFFER,
     built host-side and uploaded once per (grid, shape)).
+
+    BOUNDED cache (ADVICE r5): each entry pins an HBM buffer for its
+    (grid, shape, align); unbounded, a long-lived process sweeping many
+    shapes (the pytest session) would accumulate pinned device memory
+    forever. 16 entries cover any realistic working set (the bench
+    children are single-shape); eviction just re-uploads. Growth is
+    visible through the ``cache.bfs.*`` gauges (``obs`` registry) and
+    ``clear_bfs_caches()`` is the explicit release hook.
 
     Why not jnp.arange inside the jitted program: on the target backend
     an iota-derived gid table fuses into the while-loop body as a
@@ -558,14 +640,42 @@ def _gid_blocks(grid, nblocks: int, block_len: int, length: int,
 BFS_CLASS_LADDER = (8, 64, 512, 4096, 32768, 131072)
 
 
-@lru_cache(maxsize=None)
+@lru_cache(maxsize=8)
 def _iota_operand(kmax: int):
     """[kmax] iota as a materialized device buffer — in-program iotas
     serialize inside while-loop fusions on the target backend (the v9
-    pathology, see _gid_blocks)."""
+    pathology, see _gid_blocks). Bounded like ``_gid_blocks``."""
     import numpy as np
 
     return jax.device_put(jnp.asarray(np.arange(kmax, dtype=np.int32)))
+
+
+def clear_bfs_caches() -> None:
+    """Explicit release hook for every BFS-side cache: the gid/iota
+    DEVICE BUFFERS and the jitted single-root programs that close over
+    them (``_bfs_single_program``). Frees the pinned HBM; the next call
+    rebuilds (ADVICE r5)."""
+    _gid_blocks.cache_clear()
+    _iota_operand.cache_clear()
+    _bfs_single_program.cache_clear()
+
+
+def _record_bfs_cache_stats() -> None:
+    """obs provider: lru_cache hit/miss/size gauges, polled at export
+    time so cache growth is visible without a counter on every access."""
+    for label, fn in (
+        ("gid_blocks", _gid_blocks),
+        ("iota_operand", _iota_operand),
+        ("single_program", _bfs_single_program),
+    ):
+        ci = fn.cache_info()
+        obs.gauge(f"cache.bfs.{label}.hits", ci.hits)
+        obs.gauge(f"cache.bfs.{label}.misses", ci.misses)
+        obs.gauge(f"cache.bfs.{label}.size", ci.currsize)
+        obs.gauge(f"cache.bfs.{label}.maxsize", ci.maxsize)
+
+
+obs.register_provider(_record_bfs_cache_stats)
 
 
 def bfs_single(E, source, csc, *, tiers, csr=None, coldeg=None,

@@ -33,6 +33,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from .. import obs
 from ..parallel.grid import COL_AXIS, ROW_AXIS, Grid
 from ..parallel.redistribute import from_device_coo
 from ..parallel.spmat import TILE_SPEC, SpParMat
@@ -170,61 +171,71 @@ def kernel1_device(
     _klog("generate...")
 
     t0 = time.perf_counter()
-    # generate (includes the spec's vertex scramble), symmetricize, de-loop
-    src, dst = rmat_edges(key, scale, edgefactor * n)
-    rows = jnp.concatenate([src, dst])
-    cols = jnp.concatenate([dst, src])
-    keep = rows != cols
-    rows = jnp.where(keep, rows, n).astype(jnp.int32)
-    cols = jnp.where(keep, cols, n).astype(jnp.int32)
-    # shard the flat edge list into per-device chunks for routing
-    total = rows.shape[0]
-    chunk = -(-total // ndev)
-    pad = chunk * ndev - total
-    if pad:
-        rows = jnp.concatenate([rows, jnp.full((pad,), n, jnp.int32)])
-        cols = jnp.concatenate([cols, jnp.full((pad,), n, jnp.int32)])
-    shape = (grid.pr, grid.pc, chunk)
-    rows = jax.device_put(rows.reshape(shape), grid.tile_sharding())
-    cols = jax.device_put(cols.reshape(shape), grid.tile_sharding())
-    jax.block_until_ready((rows, cols))
+    with obs.span("k1.generate", scale=scale):
+        # generate (spec's vertex scramble included), symmetricize, de-loop
+        src, dst = rmat_edges(key, scale, edgefactor * n)
+        rows = jnp.concatenate([src, dst])
+        cols = jnp.concatenate([dst, src])
+        keep = rows != cols
+        rows = jnp.where(keep, rows, n).astype(jnp.int32)
+        cols = jnp.where(keep, cols, n).astype(jnp.int32)
+        # shard the flat edge list into per-device chunks for routing
+        total = rows.shape[0]
+        chunk = -(-total // ndev)
+        pad = chunk * ndev - total
+        if pad:
+            rows = jnp.concatenate([rows, jnp.full((pad,), n, jnp.int32)])
+            cols = jnp.concatenate([cols, jnp.full((pad,), n, jnp.int32)])
+        shape = (grid.pr, grid.pc, chunk)
+        rows = jax.device_put(rows.reshape(shape), grid.tile_sharding())
+        cols = jax.device_put(cols.reshape(shape), grid.tile_sharding())
+        jax.block_until_ready((rows, cols))
     timings["generate_s"] = time.perf_counter() - t0
     _klog(f"generate done {timings['generate_s']:.1f}s; route...")
 
     t0 = time.perf_counter()
-    vals = jnp.ones(shape, jnp.float32)
-    # defer_drop_check: the capacity-retry readback would POISON this
-    # process on the axon chip (bench.py docstring); the drop count rides
-    # along as a device scalar (timings["dropped_dev"]) for the caller to
-    # verify AFTER its timed section.
-    A, dropped = from_device_coo(
-        grid, rows, cols, vals, n, n, slack=slack, dedup_sr=SELECT2ND_MAX,
-        defer_drop_check=True,
-    )
-    jax.block_until_ready(A.vals)
+    with obs.span("k1.route_dedup"):
+        vals = jnp.ones(shape, jnp.float32)
+        # defer_drop_check: the capacity-retry readback would POISON this
+        # process on the axon chip (bench.py docstring); the drop count
+        # rides along as a device scalar (timings["dropped_dev"]) for the
+        # caller to verify AFTER its timed section.
+        A, dropped = from_device_coo(
+            grid, rows, cols, vals, n, n, slack=slack,
+            dedup_sr=SELECT2ND_MAX, defer_drop_check=True,
+        )
+        jax.block_until_ready(A.vals)
     timings["route_dedup_s"] = time.perf_counter() - t0
     timings["dropped_dev"] = dropped
     _klog(f"route done {timings['route_dedup_s']:.1f}s")
 
     if extra_relabel:
         t0 = time.perf_counter()
-        p = DistVec.randperm(grid, n, jax.random.fold_in(key, 1))
-        A = permute_vertices(A, p)
-        jax.block_until_ready(A.vals)
+        with obs.span("k1.relabel"):
+            p = DistVec.randperm(grid, n, jax.random.fold_in(key, 1))
+            A = permute_vertices(A, p)
+            jax.block_until_ready(A.vals)
         timings["relabel_s"] = time.perf_counter() - t0
 
     nkeep = jnp.asarray(n, jnp.int32)
     if compress_isolated:
         t0 = time.perf_counter()
-        p, nkeep = isolated_compression_perm(A)
-        A = permute_vertices(A, p)
-        jax.block_until_ready(A.vals)
+        with obs.span("k1.compress_isolated"):
+            p, nkeep = isolated_compression_perm(A)
+            A = permute_vertices(A, p)
+            jax.block_until_ready(A.vals)
         timings["compress_isolated_s"] = time.perf_counter() - t0
 
     t0 = time.perf_counter()
-    degrees = A.reduce(
-        PLUS_TIMES, "row", map_fn=lambda v: (v != 0).astype(v.dtype)
-    )
-    jax.block_until_ready(degrees.blocks)
+    with obs.span("k1.degree"):
+        degrees = A.reduce(
+            PLUS_TIMES, "row", map_fn=lambda v: (v != 0).astype(v.dtype)
+        )
+        jax.block_until_ready(degrees.blocks)
     timings["degree_s"] = time.perf_counter() - t0
+    if obs.ENABLED:
+        # kernel-1 stage times as histograms (the per-stage TIMING table)
+        for k, v in timings.items():
+            if isinstance(v, float):
+                obs.observe("k1." + k, v)
     return A, degrees, nkeep, timings

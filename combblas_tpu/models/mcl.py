@@ -32,6 +32,7 @@ from functools import lru_cache
 
 import jax.numpy as jnp
 
+from .. import obs
 from ..semiring import MAX_MIN, PLUS_TIMES
 from ..parallel.spgemm import mem_efficient_spgemm
 from ..parallel.spmat import SpParMat
@@ -169,6 +170,7 @@ def mcl(
     chaos_every: int = 1,
     expansion: str = "sparse",
     dense_mode: str = "bf16x3",
+    perturb_delta: float = 0.0,
 ) -> tuple[DistVec, int, float]:
     """Markov clustering. Returns (cluster labels, iterations, final chaos).
 
@@ -204,6 +206,12 @@ def mcl(
     the target chip this is >10x per iteration over the sparse path at
     scale 12-14 (PERF_NOTES_r4).
 
+    ``perturb_delta`` (dense path only) enables the plateau
+    detect-and-perturb kicks — OFF by default: the escalating self-loop
+    mass can move boundary vertices between clusters, so LIBRARY callers
+    opt in explicitly (ADVICE r5); the bench driver enables it and the
+    kick count is recorded as a span event + artifact field.
+
     ``chaos_every=K > 1`` runs K expansion iterations per host
     synchronization with the chaos residual carried ON DEVICE — zero
     device→host readbacks inside a K-block. On hardware where any D2H
@@ -233,6 +241,7 @@ def mcl(
                 recover_num=recover_num, recover_pct=recover_pct,
             ),
             mode=dense_mode,
+            perturb_delta=perturb_delta,
         )
     elif layers > 1:
         if grid3 is None:
@@ -276,14 +285,16 @@ def mcl(
         ch = float("inf")
         it = 0
         for it in range(1, max_iters + 1):
-            # scan=True bounds the expansion by the output — exactly the
-            # high-collision A-squared regime where flops >> nnz_out
-            A = mem_efficient_spgemm(
-                PLUS_TIMES, A, A, phases, prune_fn=prune_fn, scan=scan
-            )
-            A = make_col_stochastic(A)
-            ch = float(chaos(A))
-            A = inflate(A, inflation)
+            with obs.span("mcl.round", round=it):
+                # scan=True bounds the expansion by the output — exactly
+                # the high-collision A-squared regime, flops >> nnz_out
+                A = mem_efficient_spgemm(
+                    PLUS_TIMES, A, A, phases, prune_fn=prune_fn, scan=scan
+                )
+                A = make_col_stochastic(A)
+                ch = float(chaos(A))
+                A = inflate(A, inflation)
+                obs.span_event("chaos", round=it, chaos=ch)
             if ch < eps:
                 break
 
@@ -359,12 +370,15 @@ def _mcl2d_block_loop(A, inflation, eps, max_iters, K, prune_kwargs):
             worst = jnp.maximum(worst, ov)
         # SYNC POINT: the block's only device->host readbacks
         if int(worst) > 0:
+            if obs.ENABLED:
+                obs.count("mcl.block_rerolls")
             dense_tile = max(A_entry.local_rows * A_entry.local_cols, 1)
             caps = (caps[0] * 2, min(caps[1] * 2, dense_tile))
             A = A_entry
             continue
         ch = float(ch_dev)
         it += k
+        obs.span_event("mcl.block_sync", iters_done=it, chaos=ch)
         if ch < eps:
             break
     return A, it, ch
@@ -374,7 +388,7 @@ def _mcl2d_block_loop(A, inflation, eps, max_iters, K, prune_kwargs):
 
 
 def dense_mcl_program(n, npad, inflation, eps, max_iters, *, hard, select,
-                      recover, rpct, mode, perturb_delta=5e-5):
+                      recover, rpct, mode, perturb_delta=0.0):
     """The jittable whole-clustering program used by ``_mcl_dense_loop``
     (and AOT-compiled by the benchmark driver, which must not execute a
     warmup — the warmup's readback would poison the timed run on the
@@ -402,8 +416,10 @@ def dense_mcl_program(n, npad, inflation, eps, max_iters, *, hard, select,
     escalation trades the oscillating boundary vertices' assignment for
     termination, and the artifact records the kick count
     ("perturbations") so that trade is visible. ``perturb_delta=0``
-    disables. The two post-perturbation iterations are excused from the
-    detector (chaos history resets to inf)."""
+    (THE DEFAULT — because kicks can alter cluster assignments, library
+    callers must opt in; the bench driver passes 5e-5 explicitly,
+    ADVICE r5) disables. The two post-perturbation iterations are
+    excused from the detector (chaos history resets to inf)."""
     import jax
 
     from ..parallel.spgemm import _mxu_dot
@@ -503,7 +519,7 @@ def dense_mcl_program(n, npad, inflation, eps, max_iters, *, hard, select,
 
 
 def _mcl_dense_loop(A, inflation, eps, max_iters, prune_kwargs,
-                    mode="bf16x3"):
+                    mode="bf16x3", perturb_delta=0.0):
     """Single-shard MCL with DENSE state: the whole clustering runs as ONE
     ``lax.while_loop`` on the MXU — zero device→host readbacks, zero
     capacity estimation, overflow structurally impossible.
@@ -547,9 +563,20 @@ def _mcl_dense_loop(A, inflation, eps, max_iters, prune_kwargs,
     run = dense_mcl_program(
         n, npad, inflation, eps, max_iters,
         hard=hard, select=select, recover=recover, rpct=rpct, mode=mode,
+        perturb_delta=perturb_delta,
     )
     t0 = A.local_tile(A.rows, A.cols, A.vals, A.nnz)
-    m, it, ch, _hist, _npert = jax.jit(run)(t0.rows, t0.cols, t0.vals)
+    with obs.span("mcl.dense", n=int(n), mode=mode):
+        m, it, ch, _hist, _npert = jax.jit(run)(t0.rows, t0.cols, t0.vals)
+        if obs.ENABLED:
+            # this host loop already reads scalars back (int(it) below);
+            # one more tiny readback records the perturbation kicks
+            kicks = int(_npert)
+            obs.count("mcl.perturb_kicks", kicks)
+            obs.span_event(
+                "mcl.converged", iters=int(it), chaos=float(ch),
+                perturb_kicks=kicks,
+            )
 
     cap = 1 << max(int(n) * min(select + 8, 64), 1024).bit_length()
     for _ in range(6):
@@ -736,6 +763,10 @@ def _mcl3d_block_loop(A3, inflation, eps, max_iters, K, prune_kwargs):
         if bits > 0:
             # SYNC: reroll the block, doubling ONLY the overflowed group
             # and clamping the out capacity at the dense tile (ADVICE r3)
+            if obs.ENABLED:
+                # same unlabeled series as the 2D loop (a label would
+                # fragment the counter per distinct overflow-bit pattern)
+                obs.count("mcl.block_rerolls")
             fcap, ocap, pcap, stage_cap, tile_cap = caps
             if bits & 1:
                 stage_cap, tile_cap = stage_cap * 2, tile_cap * 2

@@ -61,6 +61,23 @@ EDGE_HARVEST_MAX_DIM = 65536
 EDGE_HARVEST_BITS_MAX_DIM = 262144
 
 
+def _coo_sort_dedup(rows, cols):
+    """Stable two-key sort (rows major, cols minor) + adjacent-repeat
+    mask for a COO edge list — both edge-harvest kernels must group and
+    mask duplicated input entries on device (ADVICE r5). Returns the
+    reordered (rows, cols) and the per-slot ``dup`` mask (True on every
+    repeat after the first of a group)."""
+    order_c = jnp.argsort(cols, stable=True)
+    r1, c1 = rows[order_c], cols[order_c]
+    order_r = jnp.argsort(r1, stable=True)
+    rows, cols = r1[order_r], c1[order_r]
+    dup = jnp.concatenate([
+        jnp.zeros((1,), bool),
+        (rows[1:] == rows[:-1]) & (cols[1:] == cols[:-1]),
+    ])
+    return rows, cols, dup
+
+
 def _tc_edge_harvest(rows, cols, n: int, chunk: int = 4096) -> jax.Array:
     """One-launch TC past the dense-product ceiling (32K < n <= 64K):
     per-EDGE common-neighbor harvest against the dense adjacency.
@@ -87,6 +104,11 @@ def _tc_edge_harvest(rows, cols, n: int, chunk: int = 4096) -> jax.Array:
     pays the 22 M/s random-memory wall — 87 s at scale 16).
     """
     npad = -(-n // 128) * 128
+    # ON-DEVICE DEDUP: the adjacency ``.set`` is idempotent, but the
+    # EDGE WALK below is not — a duplicated COO entry would harvest its
+    # common neighbors twice and double-count 3T; repeats are masked out
+    # of the edge list.
+    rows, cols, dup = _coo_sort_dedup(rows, cols)
     loops = rows == cols
     # dense SYMMETRIC adjacency (input is symmetrized; drop loops; padded
     # sentinel slots land in the dump row npad-? -> use drop mode)
@@ -94,7 +116,7 @@ def _tc_edge_harvest(rows, cols, n: int, chunk: int = 4096) -> jax.Array:
     d = jnp.zeros((npad, npad), jnp.bfloat16)
     d = d.at[r_all, cols].set(jnp.bfloat16(1.0), mode="drop")
     # strict-lower edge list, padded slots -> row 0 x col 0 with weight 0
-    keep = rows > cols
+    keep = (rows > cols) & ~dup
     nedge = rows.shape[0]
     epad = -(-nedge // chunk) * chunk
     er = jnp.where(keep, rows, 0)
@@ -145,16 +167,9 @@ def _tc_edge_harvest_bits(rows, cols, n: int, chunk: int = 8192) -> jax.Array:
     npad32 = nw * 32
     # ON-DEVICE DEDUP (duplicate COO entries would double-add a bit,
     # carrying into the NEXT bit and corrupting the adjacency — unlike
-    # the idempotent .set of the bf16 variant): stable two-key sort,
-    # mask repeats, zero their bit contribution AND their edge weight.
-    order_c = jnp.argsort(cols, stable=True)
-    r1, c1 = rows[order_c], cols[order_c]
-    order_r = jnp.argsort(r1, stable=True)
-    rows, cols = r1[order_r], c1[order_r]
-    dup = jnp.concatenate([
-        jnp.zeros((1,), bool),
-        (rows[1:] == rows[:-1]) & (cols[1:] == cols[:-1]),
-    ])
+    # the idempotent .set of the bf16 variant): mask repeats, zero their
+    # bit contribution AND their edge weight.
+    rows, cols, dup = _coo_sort_dedup(rows, cols)
     loops = rows == cols
     r_all = jnp.where(loops | dup, npad32, rows)  # dropped (mode="drop")
     bits = jnp.zeros((npad32, nw), jnp.uint32)

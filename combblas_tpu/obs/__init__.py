@@ -1,0 +1,309 @@
+"""``combblas_tpu.obs`` — structured telemetry for the hot paths.
+
+The reference ships a whole TIMING subsystem — global ``cblas_*`` phase
+counters compiled in under ``#ifdef TIMING`` (``CombBLAS.h:77-102``) and
+per-app tables printed after each run (``TopDownBFS.cpp:472-479``). This
+package is its structured, machine-readable replacement, three layers:
+
+1. **metrics registry** (``metrics.py``) — counters/gauges/histograms
+   with labels for scalar facts: SpGEMM symbolic vs realized fill-in,
+   redistribute/bucket drop counts, compile-cache hit/miss, per-op
+   load imbalance, jit trace counts, BFS lru-cache growth.
+2. **span/trace layer** (``spans.py``) — nested named wall-time spans
+   wrapping ``jax.profiler.TraceAnnotation`` (host spans line up with
+   the device profiler timeline), with attached per-iteration events
+   (BFS hop + frontier nnz, MCL round + chaos, SUMMA stage).
+3. **sinks** (``sinks.py``) — the in-memory per-app table, a
+   schema-versioned JSONL exporter, host-side multi-process merge, and
+   a device psum path for add-monoid counters.
+
+COST CONTRACT: everything is guarded by the module-level ``ENABLED``
+flag, checked before any dict work — with telemetry off, an
+instrumented call site costs one attribute read (and ``span`` returns a
+shared null context manager). Instrumentation lives HOST-SIDE only: no
+host callbacks or extra syncs are ever inserted into jitted code;
+counters recorded inside jit-traced Python count traces (retraces), not
+executions, and device facts are only read back where a host sync
+already exists — or when ``DEVICE_SYNC`` is explicitly opted into (CPU
+debugging; never on the readback-poisoned chip, see bench.py).
+
+Usage::
+
+    from combblas_tpu import obs
+    obs.enable(jsonl_path="trace.jsonl")
+    with obs.span("bfs", scale=20):
+        ...
+        obs.span_event("frontier", hop=3, nnz=1234)
+    obs.count("redistribute.dropped", 0)
+    obs.dump_jsonl()
+
+See docs/observability.md for the event schema and worked examples.
+"""
+
+from __future__ import annotations
+
+import os
+
+from .metrics import MetricsRegistry
+from .sinks import (
+    SCHEMA,
+    SCHEMA_VERSION,
+    aggregate,
+    encode_records,
+    merge_jsonl_files,
+    parse_jsonl,
+    psum_counters,
+    validate_record,
+    write_jsonl,
+)
+from .spans import NULL_SPAN, SpanTracker
+
+#: Master switch, checked at every instrumentation site BEFORE any work.
+#: Off by default: the hot paths must cost nothing unless telemetry is
+#: asked for (env COMBBLAS_OBS=1 or obs.enable()).
+ENABLED: bool = os.environ.get("COMBBLAS_OBS", "0") not in ("", "0")
+
+#: Opt-in for instrumentation that READS DEVICE SCALARS (e.g. realized
+#: SpGEMM output nnz). Never enable in timed sections on hardware where
+#: a D2H readback degrades later launches (bench.py module docstring).
+DEVICE_SYNC: bool = os.environ.get("COMBBLAS_OBS_SYNC", "0") not in ("", "0")
+
+registry = MetricsRegistry()
+_spans = SpanTracker()
+_providers: list = []
+_jsonl_path: str | None = None
+_hooks_installed = False
+
+
+# --- lifecycle --------------------------------------------------------------
+
+
+def enable(jsonl_path: str | None = None, *, device_sync: bool | None = None,
+           install_hooks: bool = True) -> None:
+    """Turn telemetry on (idempotent). ``jsonl_path`` configures the
+    default ``dump_jsonl`` target; ``device_sync`` opts into
+    readback-requiring metrics (CPU debugging only)."""
+    global ENABLED, DEVICE_SYNC, _jsonl_path
+    ENABLED = True
+    if device_sync is not None:
+        DEVICE_SYNC = bool(device_sync)
+    if jsonl_path is not None:
+        _jsonl_path = jsonl_path
+    if install_hooks:
+        install_jax_hooks()
+
+
+def disable() -> None:
+    global ENABLED
+    ENABLED = False
+
+
+def enable_sidecar(tag: str) -> str | None:
+    """The BENCH_OBS=1 convention shared by the bench drivers: enable
+    telemetry with a per-process JSONL sidecar under ``$BENCH_OBS_DIR``
+    (default ``<tmpdir>/combblas_obs``), named ``obs-<tag>-<pid>.jsonl``.
+    Returns the sidecar path, or None when ``BENCH_OBS`` is not ``1``.
+    ``DEVICE_SYNC`` stays off: a bench child must never gain a readback
+    from telemetry (bench.py module docstring)."""
+    if os.environ.get("BENCH_OBS") != "1":
+        return None
+    import tempfile
+
+    d = os.environ.get("BENCH_OBS_DIR") or os.path.join(
+        tempfile.gettempdir(), "combblas_obs"
+    )
+    os.makedirs(d, exist_ok=True)
+    path = os.path.join(d, f"obs-{tag}-{os.getpid()}.jsonl")
+    enable(jsonl_path=path, device_sync=False)
+    return path
+
+
+def enabled() -> bool:
+    return ENABLED
+
+
+def reset() -> None:
+    """Clear every metric, span, and event (the flag is untouched)."""
+    registry.clear()
+    _spans.clear()
+
+
+def reset_spans() -> None:
+    """Clear only the (seconds, calls) span table (the timers-shim
+    reset) — the structured span log and events belong to the obs
+    subsystem and survive; use ``reset()`` for a full wipe."""
+    _spans.clear_table()
+
+
+# --- writers ----------------------------------------------------------------
+
+
+def count(name: str, value=1, **labels) -> None:
+    if not ENABLED:
+        return
+    registry.count(name, value, **labels)
+
+
+def gauge(name: str, value, **labels) -> None:
+    if not ENABLED:
+        return
+    registry.gauge(name, value, **labels)
+
+
+def observe(name: str, value, **labels) -> None:
+    if not ENABLED:
+        return
+    registry.observe(name, value, **labels)
+
+
+def span(name: str, *, sync=None, force: bool = False, **attrs):
+    """Context manager timing the enclosed block under ``name``.
+
+    ``sync``: optional array/pytree to ``block_until_ready`` before the
+    timer closes (async dispatch must not hide device time). ``force``
+    records even when telemetry is globally off (the ``utils/timers``
+    compatibility path) — but then only into the (seconds, calls) table,
+    like the old timers, never the per-call structured log. ``attrs``
+    become span attributes in the export.
+    """
+    if not (ENABLED or force):
+        return NULL_SPAN
+    return _spans.open(name, True, sync=sync, log=ENABLED, **attrs)
+
+
+def span_event(name: str, **fields) -> None:
+    """Attach a per-iteration record (hop/round/stage) to the innermost
+    open span — or log it top-level if no span is open."""
+    if not ENABLED:
+        return
+    _spans.event(name, **fields)
+
+
+# --- providers (pull-style gauges, polled at export time) -------------------
+
+
+def register_provider(fn) -> None:
+    """Register a zero-arg callable that refreshes gauges (via
+    ``obs.gauge``) when a report/dump is produced — e.g. lru_cache
+    hit/miss/size exporters that would be wasteful to push on every
+    cache access."""
+    if fn not in _providers:
+        _providers.append(fn)
+
+
+def _run_providers() -> None:
+    if not ENABLED:
+        return
+    for fn in list(_providers):
+        try:
+            fn()
+        except Exception:  # a broken provider must not kill the export
+            registry.count("obs.provider_errors")
+
+
+# --- readers / sinks --------------------------------------------------------
+
+
+def report(reset: bool = False) -> dict[str, tuple[float, int]]:
+    """The per-app timing table: {span name: (seconds, calls)} — what the
+    reference prints after each run (TopDownBFS.cpp:472-479).
+    ``reset=True`` clears only this table, not the structured span
+    log/events (``reset()`` is the full wipe)."""
+    out = _spans.table()
+    if reset:
+        _spans.clear_table()
+    return out
+
+
+def span_seconds(name: str) -> float:
+    return _spans.seconds(name)
+
+
+def print_report(reset: bool = False) -> None:
+    for k, (sec, n) in report(reset=reset).items():
+        print(f"{k:32s} {sec:10.4f}s  x{n}")
+
+
+def metrics_snapshot() -> list[dict]:
+    _run_providers()
+    return registry.snapshot()
+
+
+def dump_jsonl(path: str | None = None, *, process: int | None = None,
+               nprocs: int | None = None) -> str:
+    """Write the full telemetry state as one schema-versioned JSONL file
+    (meta line, spans, events, metrics). Default path is the one given
+    to ``enable``; the file is rewritten whole on each call."""
+    path = path or _jsonl_path
+    if path is None:
+        raise ValueError("no JSONL path: pass one or enable(jsonl_path=...)")
+    if process is None or nprocs is None:
+        try:
+            import jax
+
+            process = jax.process_index() if process is None else process
+            nprocs = jax.process_count() if nprocs is None else nprocs
+        except Exception:
+            process, nprocs = process or 0, nprocs or 1
+    _run_providers()
+    records = encode_records(
+        registry.snapshot(), _spans, process=process, nprocs=nprocs
+    )
+    return write_jsonl(path, records)
+
+
+# --- jax.monitoring bridge --------------------------------------------------
+
+_CACHE_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+_CACHE_MISS_EVENT = "/jax/compilation_cache/cache_misses"
+
+
+def install_jax_hooks() -> bool:
+    """Bridge ``jax.monitoring`` into the registry (idempotent):
+    persistent-compile-cache hits/misses become the ``compile_cache.*``
+    counters, every other ``/jax/...`` event is counted under its own
+    path, and duration events (tracing/backend-compile times) land in
+    histograms — the jit retrace/compile visibility layer."""
+    global _hooks_installed
+    if _hooks_installed:
+        return True
+    try:
+        from jax import monitoring
+    except Exception:
+        return False
+
+    def _on_event(event: str, **kw):
+        if not ENABLED:
+            return
+        if event == _CACHE_HIT_EVENT:
+            registry.count("compile_cache.hits")
+        elif event == _CACHE_MISS_EVENT:
+            registry.count("compile_cache.misses")
+        else:
+            registry.count(event)
+
+    def _on_duration(event: str, duration_secs: float, **kw):
+        if not ENABLED:
+            return
+        registry.observe(event, duration_secs)
+
+    monitoring.register_event_listener(_on_event)
+    monitoring.register_event_duration_secs_listener(_on_duration)
+    # seed the cache counters so every dump carries them, hit or not
+    registry.count("compile_cache.hits", 0)
+    registry.count("compile_cache.misses", 0)
+    _hooks_installed = True
+    return True
+
+
+__all__ = [
+    "ENABLED", "DEVICE_SYNC", "SCHEMA", "SCHEMA_VERSION",
+    "enable", "disable", "enabled", "enable_sidecar", "reset",
+    "reset_spans",
+    "count", "gauge", "observe", "span", "span_event",
+    "register_provider", "report", "print_report", "span_seconds",
+    "metrics_snapshot", "dump_jsonl", "install_jax_hooks",
+    "parse_jsonl", "merge_jsonl_files", "aggregate", "validate_record",
+    "encode_records", "write_jsonl", "psum_counters", "registry",
+    "MetricsRegistry", "SpanTracker", "NULL_SPAN",
+]

@@ -1,0 +1,117 @@
+"""Metrics registry: counters, gauges, histograms with labels.
+
+The structured replacement for the reference's global ``cblas_*`` counter
+variables (``CombBLAS.h:77-102``): instead of a fixed set of doubles, a
+registry of named scalar facts — nnz in/out, SpGEMM symbolic flops,
+redistribute drop counts, compile-cache hits, per-op load imbalance —
+each optionally qualified by labels (``kernel="summa"``), snapshottable
+for the JSONL exporter and mergeable across processes.
+
+Everything here is plain host-side Python over dicts: no JAX arrays ever
+enter the registry (call sites convert to ``int``/``float`` first), so a
+metric can never smuggle a tracer or force a device sync.
+"""
+
+from __future__ import annotations
+
+import threading
+
+#: Metric-kind tags used in snapshots and the JSONL schema.
+KIND_COUNTER = "counter"
+KIND_GAUGE = "gauge"
+KIND_HISTOGRAM = "histogram"
+
+
+def _label_key(labels: dict) -> tuple:
+    return tuple(sorted(labels.items()))
+
+
+class MetricsRegistry:
+    """Threadsafe in-memory metric store.
+
+    Counters are monotonically-added floats/ints; gauges hold the last
+    set value; histograms keep (count, sum, min, max) — enough for the
+    per-app tables and for cross-process aggregation without binning
+    policy baked in.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: dict[tuple, float] = {}
+        self._gauges: dict[tuple, float] = {}
+        self._hists: dict[tuple, list] = {}  # [count, sum, min, max]
+        self._labels: dict[tuple, dict] = {}  # key -> labels dict
+
+    def _key(self, name: str, labels: dict) -> tuple:
+        key = (name, _label_key(labels))
+        if labels and key not in self._labels:
+            self._labels[key] = dict(labels)
+        return key
+
+    # -- writers -----------------------------------------------------------
+    def count(self, name: str, value=1, **labels):
+        with self._lock:
+            key = self._key(name, labels)
+            self._counters[key] = self._counters.get(key, 0) + value
+
+    def gauge(self, name: str, value, **labels):
+        with self._lock:
+            self._gauges[self._key(name, labels)] = value
+
+    def observe(self, name: str, value, **labels):
+        with self._lock:
+            key = self._key(name, labels)
+            h = self._hists.get(key)
+            if h is None:
+                self._hists[key] = [1, value, value, value]
+            else:
+                h[0] += 1
+                h[1] += value
+                h[2] = min(h[2], value)
+                h[3] = max(h[3], value)
+
+    # -- readers -----------------------------------------------------------
+    def get_counter(self, name: str, default=0, **labels):
+        return self._counters.get((name, _label_key(labels)), default)
+
+    def get_gauge(self, name: str, default=None, **labels):
+        return self._gauges.get((name, _label_key(labels)), default)
+
+    def get_histogram(self, name: str, **labels):
+        h = self._hists.get((name, _label_key(labels)))
+        if h is None:
+            return None
+        return {"count": h[0], "sum": h[1], "min": h[2], "max": h[3]}
+
+    def empty(self) -> bool:
+        return not (self._counters or self._gauges or self._hists)
+
+    def snapshot(self) -> list[dict]:
+        """All metrics as schema records (no ``v``/``ts`` envelope — the
+        sink adds those)."""
+        with self._lock:
+            out = []
+            for (name, lk), v in sorted(self._counters.items()):
+                out.append({
+                    "kind": KIND_COUNTER, "name": name,
+                    "labels": dict(lk), "value": v,
+                })
+            for (name, lk), v in sorted(self._gauges.items()):
+                out.append({
+                    "kind": KIND_GAUGE, "name": name,
+                    "labels": dict(lk), "value": v,
+                })
+            for (name, lk), h in sorted(self._hists.items()):
+                out.append({
+                    "kind": KIND_HISTOGRAM, "name": name,
+                    "labels": dict(lk), "count": h[0], "sum": h[1],
+                    "min": h[2], "max": h[3],
+                })
+            return out
+
+    def clear(self):
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._hists.clear()
+            self._labels.clear()

@@ -28,6 +28,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from .. import obs
 from ..ops.tuples import SpTuples
 from ..semiring import Semiring
 from .grid import COL_AXIS, ROW_AXIS, Grid
@@ -104,6 +105,9 @@ def redistribute_coo(
     construction. The tile-overflow term counts DISTINCT keys when
     ``dedup_sr`` is set, so a zero count always means a complete matrix.
     """
+    if obs.ENABLED:
+        # trace-time only (jitted): counts (re)traces per static config
+        obs.count("trace.redistribute_coo")
     lr = -(-nrows // grid.pr)
     lc = -(-ncols // grid.pc)
     pr_, pc_ = grid.pr, grid.pc
@@ -215,6 +219,9 @@ def from_device_coo(
     from .spgemm import host_value
 
     if defer_drop_check:
+        if obs.ENABLED:
+            obs.gauge("redistribute.stage_capacity", stage_cap)
+            obs.gauge("redistribute.tile_capacity", tile_cap)
         mat, dropped = redistribute_coo(
             grid, rows, cols, vals, nrows, ncols,
             stage_capacity=stage_cap, tile_capacity=tile_cap,
@@ -223,17 +230,28 @@ def from_device_coo(
         return mat, dropped
 
     nd = 0
-    for _ in range(max_retries + 1):
-        mat, dropped = redistribute_coo(
-            grid, rows, cols, vals, nrows, ncols,
-            stage_capacity=stage_cap, tile_capacity=tile_cap,
-            dedup_sr=dedup_sr,
-        )
-        nd = int(host_value(dropped))
-        if nd == 0:
-            return mat
-        stage_cap *= 2
-        tile_cap *= 2
+    with obs.span("redistribute", chunk=int(chunk)):
+        for attempt in range(max_retries + 1):
+            mat, dropped = redistribute_coo(
+                grid, rows, cols, vals, nrows, ncols,
+                stage_capacity=stage_cap, tile_capacity=tile_cap,
+                dedup_sr=dedup_sr,
+            )
+            nd = int(host_value(dropped))
+            if obs.ENABLED:
+                # the actual drop count per attempt — zero on success, so
+                # the counter reads as total tuples ever bounced
+                obs.count("redistribute.dropped", nd)
+                obs.span_event(
+                    "route", attempt=attempt, dropped=nd,
+                    stage_capacity=stage_cap, tile_capacity=tile_cap,
+                )
+            if nd == 0:
+                return mat
+            if obs.ENABLED:
+                obs.count("redistribute.retries")
+            stage_cap *= 2
+            tile_cap *= 2
     raise ValueError(
         f"redistribute still dropped {nd} tuples after {max_retries} "
         "capacity doublings; call redistribute_coo with explicit capacities"

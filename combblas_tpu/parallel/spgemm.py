@@ -37,6 +37,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from .. import obs
 from ..ops.compressed import CSR
 from ..ops.spgemm import expand as esc_expand
 from ..ops.tuples import SpTuples
@@ -102,6 +103,10 @@ def summa_spgemm(
     ``out_capacity`` bounds the final per-tile nnz.
     """
     _check_compat(A, B)
+    if obs.ENABLED:
+        # trace-time only (this fn is jitted): counts (re)traces per
+        # static config, never executions — the jit retrace visibility
+        obs.count("trace.summa_spgemm", ring=ring)
     grid = A.grid
     p = grid.pr
 
@@ -254,8 +259,24 @@ def summa_capacities(A: SpParMat, B: SpParMat, slack: float = 1.05):
     work (D2H poison, see bench.py).
     """
     per_stage = host_value(summa_stage_flops(A, B)).astype(np.float64)
+    if obs.ENABLED:
+        _record_symbolic_metrics(per_stage)
     return _caps_from_stage_flops(
         per_stage, A.local_rows * B.local_cols, slack
+    )
+
+
+def _record_symbolic_metrics(per_stage: np.ndarray) -> None:
+    """Registry facts from one symbolic pass: total symbolic fill-in
+    (expansion slots — the flops-side of symbolic-vs-realized) and the
+    per-tile LoadImbalance (max/mean over output tiles, the reference's
+    ``LoadImbalance`` statistic)."""
+    per_tile = per_stage.sum(axis=0)
+    mean = float(per_tile.mean())
+    obs.count("spgemm.symbolic_fill_slots", float(per_stage.sum()))
+    obs.gauge(
+        "spgemm.load_imbalance",
+        float(per_tile.max() / mean) if mean > 0 else 1.0,
     )
 
 
@@ -318,6 +339,8 @@ def summa_capacities_host(
         per_stage = summa_stage_flops_host(
             grid, rows_a, cols_a, rows_b, cols_b, nrows_a, ncols_a, ncols_b
         )
+    if obs.ENABLED:
+        _record_symbolic_metrics(np.asarray(per_stage, np.float64))
     dense_tile = grid.local_rows(nrows_a) * grid.local_cols(ncols_b)
     return _caps_from_stage_flops(per_stage, dense_tile, slack)
 
@@ -396,7 +419,13 @@ def mem_efficient_spgemm(
         warnings.warn(
             PhaseAdjustedWarning(phases, adj, lc), stacklevel=2,
         )
+        if obs.ENABLED:
+            obs.count("spgemm.phase_adjusted")
         phases = adj
+    if obs.ENABLED:
+        # after adjustment: the phase count actually executed, matching
+        # the number of spgemm.phase spans below
+        obs.gauge("spgemm.phases", phases, scan=str(scan))
     mult = (
         (lambda a, b: spgemm_scan(sr, a, b, slack=slack))
         if scan
@@ -406,13 +435,14 @@ def mem_efficient_spgemm(
         C = mult(A, B)
         return prune_fn(C) if prune_fn is not None else C
     outs = []
-    for Bs in B.col_split(phases):
+    for pi, Bs in enumerate(B.col_split(phases)):
         # A phase holds ~1/phases of the nnz but inherits B's full slot
         # capacity from col_split; truncate so the per-phase SUMMA gathers
         # phase-sized arrays (the point of phasing is peak-memory reduction).
-        C = mult(A, Bs.shrink_to_fit())
-        if prune_fn is not None:
-            C = prune_fn(C)
+        with obs.span("spgemm.phase", phase=pi):
+            C = mult(A, Bs.shrink_to_fit())
+            if prune_fn is not None:
+                C = prune_fn(C)
         outs.append(C)
     return SpParMat.col_concatenate(outs)
 
@@ -530,14 +560,31 @@ def spgemm(
     slack) so iterative callers (MCL's expand loop, BC's per-level products)
     hit the XLA compilation cache instead of recompiling for every new nnz.
     """
-    flop_cap, out_cap = summa_capacities(A, B, slack)
-    if pow2_caps:
-        dense_tile = A.local_rows * B.local_cols
-        flop_cap = 1 << (flop_cap - 1).bit_length()
-        out_cap = min(1 << (out_cap - 1).bit_length(), max(dense_tile, 1))
-    return summa_spgemm(
-        sr, A, B, flop_capacity=flop_cap, out_capacity=out_cap
-    )
+    with obs.span("spgemm", sr=sr.name):
+        flop_cap, out_cap = summa_capacities(A, B, slack)
+        if pow2_caps:
+            dense_tile = A.local_rows * B.local_cols
+            flop_cap = 1 << (flop_cap - 1).bit_length()
+            out_cap = min(1 << (out_cap - 1).bit_length(), max(dense_tile, 1))
+        if obs.ENABLED:
+            obs.span_event(
+                "capacities", flop_capacity=flop_cap, out_capacity=out_cap
+            )
+        C = summa_spgemm(
+            sr, A, B, flop_capacity=flop_cap, out_capacity=out_cap
+        )
+        _record_realized_nnz(C)
+        return C
+
+
+def _record_realized_nnz(C: SpParMat) -> None:
+    """Realized output fill-in (the other half of symbolic-vs-realized).
+    Reading ``C.nnz`` is a device readback, so this records ONLY under
+    the explicit ``obs.DEVICE_SYNC`` opt-in — never in a timed section
+    on readback-poisoned hardware (bench.py module docstring)."""
+    if obs.ENABLED and obs.DEVICE_SYNC:
+        realized = int(np.asarray(host_value(C.nnz)).sum())
+        obs.count("spgemm.realized_nnz", realized)
 
 
 @partial(
@@ -575,6 +622,8 @@ def summa_spgemm_scan(
     realized iteratively).
     """
     _check_compat(A, B)
+    if obs.ENABLED:
+        obs.count("trace.summa_spgemm_scan", ring=ring)
     grid = A.grid
     p = grid.pr
 
@@ -668,30 +717,40 @@ def spgemm_scan(
     flops-shaped outputs. One host sync per attempt (off the hot path; on
     the axon chip prefer a caller-provided ``out_capacity``).
     """
-    flop_cap, flops_out_cap = summa_capacities(A, B, slack)
-    if out_capacity is None:
-        # optimistic: half the flops bound, floor at the input sizes
-        out_capacity = max(
-            min(flops_out_cap, max(A.capacity, B.capacity)), 64
+    with obs.span("spgemm.scan", sr=sr.name):
+        flop_cap, flops_out_cap = summa_capacities(A, B, slack)
+        if out_capacity is None:
+            # optimistic: half the flops bound, floor at the input sizes
+            out_capacity = max(
+                min(flops_out_cap, max(A.capacity, B.capacity)), 64
+            )
+        out_capacity = 1 << (int(out_capacity) - 1).bit_length()
+        for attempt in range(max_retries + 1):
+            C, overflow = summa_spgemm_scan(
+                sr, A, B, flop_capacity=flop_cap, out_capacity=out_capacity,
+                ring=ring,
+            )
+            over = int(overflow)
+            if over <= 0:
+                if obs.ENABLED:
+                    obs.count("spgemm.scan.overflow_retries", attempt)
+                    obs.span_event(
+                        "sized", flop_capacity=flop_cap,
+                        out_capacity=out_capacity, retries=attempt,
+                    )
+                    _record_realized_nnz(C)
+                return C
+            if obs.ENABLED:
+                obs.count("spgemm.scan.overflow_slots", over)
+            # ``over`` under-reports when an early stage truncated (see
+            # summa_spgemm_scan docstring) — grow geometrically, at least 2x
+            out_capacity = max(
+                1 << (out_capacity + over - 1).bit_length(), out_capacity * 2
+            )
+        raise ValueError(
+            f"spgemm_scan still overflowing by {over} after {max_retries} "
+            "retries; pass an explicit out_capacity"
         )
-    out_capacity = 1 << (int(out_capacity) - 1).bit_length()
-    for _ in range(max_retries + 1):
-        C, overflow = summa_spgemm_scan(
-            sr, A, B, flop_capacity=flop_cap, out_capacity=out_capacity,
-            ring=ring,
-        )
-        over = int(overflow)
-        if over <= 0:
-            return C
-        # ``over`` under-reports when an early stage truncated (see
-        # summa_spgemm_scan docstring) — grow geometrically, at least 2x
-        out_capacity = max(
-            1 << (out_capacity + over - 1).bit_length(), out_capacity * 2
-        )
-    raise ValueError(
-        f"spgemm_scan still overflowing by {over} after {max_retries} "
-        "retries; pass an explicit out_capacity"
-    )
 
 
 def _pad128(x: int, to: int = 512) -> int:
@@ -776,6 +835,8 @@ def summa_spgemm_mxu(
     from ..ops.spgemm import densify, sparsify_windowed
 
     _check_compat(A, B)
+    if obs.ENABLED:
+        obs.count("trace.summa_spgemm_mxu", mode=mode)
     kind = _PALLAS_KINDS.get(sr.name)
     assert kind is not None, (
         f"summa_spgemm_mxu supports semirings {sorted(_PALLAS_KINDS)}; "
@@ -868,13 +929,16 @@ def spgemm_auto(
         out_capacity = max(A.capacity, B.capacity, 64)
     out_capacity = 1 << (int(out_capacity) - 1).bit_length()
     over = 0
-    for _ in range(max_retries + 1):
+    for attempt in range(max_retries + 1):
         C, overflow = summa_spgemm_mxu(
             sr, A, B, out_capacity=out_capacity, mode=mode,
             interpret=interpret,
         )
         over = int(overflow)
         if over <= 0:
+            if obs.ENABLED:
+                obs.count("spgemm.mxu.overflow_retries", attempt)
+                _record_realized_nnz(C)
             return C
         out_capacity = 1 << (out_capacity + over - 1).bit_length()
     raise ValueError(

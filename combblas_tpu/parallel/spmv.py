@@ -26,6 +26,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from .. import obs
 from ..ops.compressed import CSC
 from ..ops.spmv import spmspv as local_spmspv
 from ..ops.spmv import spmspv_dense_out
@@ -46,6 +47,10 @@ def dist_spmv(sr: Semiring, A, x: DistVec) -> DistVec:
     """
     from .ellmat import EllParMat, dist_spmv_ell
 
+    if obs.ENABLED:
+        # host-visible dispatches: eager calls + jit traces (never runs
+        # inside compiled code — trace-time Python only)
+        obs.count("spmv.dispatch", kernel="dist_spmv")
     if isinstance(A, EllParMat):
         return dist_spmv_ell(sr, A, x)
     assert x.length == A.ncols, (x.length, A.ncols)
@@ -80,6 +85,8 @@ def dist_spmv_masked(
     """
     from .ellmat import EllParMat, dist_spmv_ell_masked
 
+    if obs.ENABLED:
+        obs.count("spmv.dispatch", kernel="dist_spmv_masked")
     if isinstance(A, EllParMat):
         return dist_spmv_ell_masked(sr, A, x, row_active)
     assert x.length == A.ncols
@@ -117,6 +124,8 @@ def dist_spmspv(
     (our masked-dense FullyDistSpVec stance; see parallel/vec.py docstring).
     """
     assert x.length == A.ncols
+    if obs.ENABLED:
+        obs.count("spmv.dispatch", kernel="dist_spmspv")
     lr = A.local_rows
 
     def mark(rows, cols, vals, nnz, xactblk):
@@ -180,6 +189,8 @@ def dist_spmspv_masked(
     top-down regime.
     """
     assert x.length == A.ncols
+    if obs.ENABLED:
+        obs.count("spmv.dispatch", kernel="dist_spmspv_masked")
     x = x.realign("col")
     x_active = x_active.realign("col")
     row_active = row_active.realign("row")

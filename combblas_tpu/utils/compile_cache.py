@@ -4,11 +4,18 @@ Verified to work through the axon remote compiler (2.7 s -> 0.5 s
 cold-process recompile). One definition so the official bench and every
 probe measure under identical cache behavior; ``BENCH_NOCACHE=1``
 disables for diagnostics.
+
+When telemetry is on (``combblas_tpu.obs``), enabling the cache also
+installs the jax.monitoring bridge so persistent-cache hits/misses
+surface as the ``compile_cache.hits`` / ``compile_cache.misses``
+counters in every report/JSONL dump.
 """
 
 from __future__ import annotations
 
 import os
+
+from .. import obs
 
 CACHE_DIR = os.path.normpath(
     os.path.join(os.path.dirname(__file__), "..", "..", ".jax_cache")
@@ -18,7 +25,10 @@ CACHE_DIR = os.path.normpath(
 def enable_compile_cache(cache_dir: str | None = None) -> None:
     import jax
 
+    if obs.ENABLED:
+        obs.install_jax_hooks()
     if os.environ.get("BENCH_NOCACHE") == "1":
+        obs.count("compile_cache.disabled")
         return
     jax.config.update(
         "jax_compilation_cache_dir", cache_dir or CACHE_DIR

@@ -1,70 +1,56 @@
-"""Phase timers + profiler hooks (≈ the reference's TIMING subsystem).
+"""Phase timers — COMPATIBILITY SHIM over ``combblas_tpu.obs``.
 
-The reference accumulates global per-phase wall times inside kernels under
-``#ifdef TIMING`` (``CombBLAS.h:77-102``: cblas_alltoalltime /
-allgathertime / localspmvtime / mergeconttime / transvectime, plus the
-mcl_* family) and prints them per app (``TopDownBFS.cpp:472-479``). Under
-XLA, phases inside one compiled program can't be host-timed — the analog
-is (a) named host-side phase accumulation around jitted calls (this module)
-and (b) ``jax.profiler`` traces with named annotations for on-device
-timelines (``trace`` / ``annotate`` below; view in TensorBoard/Perfetto).
+This module used to be the whole TIMING story (host-side phase
+accumulation ≈ the reference's cblas_* counters, CombBLAS.h:77-102). The
+structured telemetry subsystem (``combblas_tpu/obs/``) subsumes it:
+spans carry nesting, attributes, per-iteration events, and JSONL export.
+Existing callers keep working — ``phase`` records into the same span
+accumulator ``obs.report()`` reads — but new code should use
+``obs.span`` / ``obs.span_event`` directly.
+
+``ENABLED`` here keeps its historical meaning (phases accumulate even
+when the global obs flag is off); flip it False to silence this module
+alone.
 """
 
 from __future__ import annotations
 
 import contextlib
-import time
-from collections import defaultdict
 
 import jax
 
-_ACC: dict[str, float] = defaultdict(float)
-_COUNT: dict[str, int] = defaultdict(int)
+from .. import obs
+
 ENABLED = True
 
 
-@contextlib.contextmanager
 def phase(name: str, *, sync=None):
     """Accumulate wall time under ``name`` (≈ one cblas_* counter).
 
     ``sync``: optional array/pytree to ``block_until_ready`` before closing
     the timer, so async dispatch doesn't hide device time.
     """
-    if not ENABLED:
-        yield
-        return
-    with jax.profiler.TraceAnnotation(name):
-        t0 = time.perf_counter()
-        try:
-            yield
-        finally:
-            if sync is not None:
-                jax.block_until_ready(sync)
-            _ACC[name] += time.perf_counter() - t0
-            _COUNT[name] += 1
+    if not ENABLED:  # the historical silencing knob, obs flag or not
+        return obs.NULL_SPAN
+    return obs.span(name, sync=sync, force=True)
 
 
 def get(name: str) -> float:
-    return _ACC.get(name, 0.0)
+    return obs.span_seconds(name)
 
 
 def report(reset: bool = False) -> dict[str, tuple[float, int]]:
     """{name: (seconds, calls)} — the per-app timing table the reference
     prints after each run."""
-    out = {k: (_ACC[k], _COUNT[k]) for k in sorted(_ACC)}
-    if reset:
-        reset_all()
-    return out
+    return obs.report(reset=reset)
 
 
 def reset_all():
-    _ACC.clear()
-    _COUNT.clear()
+    obs.reset_spans()
 
 
 def print_report(reset: bool = False):
-    for k, (sec, n) in report(reset=reset).items():
-        print(f"{k:32s} {sec:10.4f}s  x{n}")
+    obs.print_report(reset=reset)
 
 
 @contextlib.contextmanager

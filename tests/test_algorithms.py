@@ -370,10 +370,13 @@ def test_triangle_count_edge_harvest_kernel(rng):
     assert triangle_count(A, kernel="dense") == want
 
 
-def test_triangle_count_edge_harvest_duplicates(rng):
-    """Bit-packed edge-harvest must survive duplicate COO entries (a
-    double-added bit would carry into the next bit and corrupt the
-    adjacency) — dedup happens on device."""
+@pytest.mark.parametrize("kernel", ["edgeharvest", "edgeharvest_bf16"])
+def test_triangle_count_edge_harvest_duplicates(rng, kernel):
+    """Both edge-harvest variants must survive duplicate COO entries: in
+    the bits variant a double-added bit would carry into the next bit
+    and corrupt the adjacency; in the bf16 variant a duplicated edge
+    would walk its common neighbors twice and double-count 3T (ADVICE
+    r5) — dedup happens on device in both."""
     from combblas_tpu.models.tc import triangle_count
 
     grid = Grid.make(1, 1)
@@ -395,4 +398,4 @@ def test_triangle_count_edge_harvest_duplicates(rng):
         ),
         kernel="sparse",
     )
-    assert triangle_count(A, kernel="edgeharvest") == want
+    assert triangle_count(A, kernel=kernel) == want
