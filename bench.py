@@ -710,8 +710,58 @@ def emit(runs, seq_runs, construction_s, k1_info, t_start):
     print(json.dumps(out), flush=True)
 
 
+def serve_bench_main():
+    """BENCH_SERVE=1: the query-serving benchmark
+    (benchmarks/serve_bench.py — batched lanes vs one-call-per-query on
+    the 8-virtual-device CPU mesh), run as a subprocess so its forced
+    CPU platform / virtual-device flags never touch this process's
+    backend. The child emits its serve-throughput telemetry as a JSONL
+    sidecar through the existing obs.enable_sidecar plumbing
+    (BENCH_OBS defaults ON for this path; the sidecar path rides the
+    JSON line as "obs_jsonl")."""
+    env = dict(os.environ)
+    env.setdefault("BENCH_OBS", "1")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    )
+    script = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "benchmarks", "serve_bench.py",
+    )
+    try:
+        r = subprocess.run(
+            [sys.executable, script], capture_output=True, text=True,
+            env=env,
+            timeout=float(os.environ.get("BENCH_CHILD_TIMEOUT", "1800")),
+        )
+    except subprocess.TimeoutExpired as e:
+        print(json.dumps({
+            "metric": "serve_throughput", "value": 0.0,
+            "error": f"serve bench child timed out after {e.timeout}s",
+        }), flush=True)
+        return
+    lines = [l for l in r.stdout.strip().splitlines() if l.strip()]
+    # same guard as run_child: the official stream must stay one valid
+    # JSON line even when the child crashes or leaves stray stdout
+    try:
+        if r.returncode != 0 or not lines:
+            raise json.JSONDecodeError("child failed", "", 0)
+        out = json.loads(lines[-1])
+    except json.JSONDecodeError:
+        out = {
+            "metric": "serve_throughput", "value": 0.0,
+            "error": (r.stderr or "no output")[-2000:],
+        }
+    print(json.dumps(out), flush=True)
+
+
 def main():
     t_start = time.perf_counter()
+    if os.environ.get("BENCH_SERVE") == "1":
+        serve_bench_main()
+        return
     if os.environ.get("BENCH_SEQ_ROOT_IDX") is not None:
         seq_child(
             os.environ["BENCH_GRAPH_NPZ"],

@@ -860,7 +860,14 @@ def _obs_finish():
     from combblas_tpu import obs
 
     if obs.ENABLED:
-        print(f"[obs] {obs.dump_jsonl()}", file=sys.stderr, flush=True)
+        # telemetry must never fail the bench: COMBBLAS_OBS=1 enables
+        # obs WITHOUT a sidecar path (that's BENCH_OBS=1's job), in
+        # which case there is nothing to dump
+        try:
+            print(f"[obs] {obs.dump_jsonl()}", file=sys.stderr,
+                  flush=True)
+        except Exception:  # no path configured, unwritable dir, ...
+            pass
 
 
 if __name__ == "__main__":

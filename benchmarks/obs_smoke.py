@@ -58,9 +58,15 @@ def run(scale: int = SCALE, edgefactor: int = EDGEFACTOR,
     obs.enable(jsonl_path=out_path, device_sync=True)
 
     # persistent compile cache into a scratch dir so cache hit/miss
-    # events fire without touching the repo's .jax_cache
+    # events fire without touching the repo's .jax_cache — reusing the
+    # process's already-committed dir when there is one (the cache dir
+    # is process-global and idempotence-guarded; a second run() in the
+    # same process must not look like a retarget)
+    from combblas_tpu.utils.compile_cache import configured_dir
+
     enable_compile_cache(
-        cache_dir or tempfile.mkdtemp(prefix="obs_smoke_cache_")
+        cache_dir or configured_dir()
+        or tempfile.mkdtemp(prefix="obs_smoke_cache_")
     )
 
     with obs.span("obs_smoke", scale=scale, edgefactor=edgefactor):

@@ -1,0 +1,198 @@
+"""Query-serving benchmark: batched lanes vs one-call-per-query.
+
+    python benchmarks/serve_bench.py            # 8 virtual CPU devices
+
+Measures, on the tier-1 8-virtual-device CPU mesh (2x4 grid), a mixed
+BFS/PageRank query stream served two ways over the SAME warm engine:
+
+  * BASELINE — one engine call per query (the warm width-1 plan: no
+    compile or trace cost is charged to the baseline; the gap is purely
+    the batching, i.e. per-launch overhead and unamortized lanes);
+  * BATCHED — the ``serve.Server`` micro-batcher coalescing the stream
+    into width-``BENCH_SERVE_WIDTH`` (default 16) lane buckets.
+
+Reports queries/s for both plus per-request p50/p99 latency under the
+batched server, and CHECKS the serving acceptance gates:
+
+  * ``speedup`` >= 4x at batch width 16 (the batched-serving payoff);
+  * ``retraces_after_warmup`` == 0 — asserted via the engine's
+    trace-time counter, mirrored in obs as ``trace.serve``;
+  * ``backpressure_ok`` — a full queue REJECTS ``submit()`` with a
+    retry-after hint instead of blocking unboundedly.
+
+"ok" in the final JSON line is the AND of the three gates.
+
+BENCH_OBS=1 attaches the structured telemetry sidecar through
+``obs.enable_sidecar`` (queue-depth gauge, occupancy/padding-waste and
+latency histograms, plan-cache + trace counters land in the JSONL);
+``bench.py`` invokes this file under ``BENCH_SERVE=1`` with the sidecar
+on by default.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+# tier-1 virtual mesh, set BEFORE jax initializes its backend
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "--xla_force_host_platform_device_count" not in os.environ.get(
+    "XLA_FLAGS", ""
+):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    )
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+)
+
+SCALE = int(os.environ.get("BENCH_SERVE_SCALE", "9"))
+EDGEFACTOR = int(os.environ.get("BENCH_SERVE_EDGEFACTOR", "8"))
+WIDTH = int(os.environ.get("BENCH_SERVE_WIDTH", "16"))
+NQUERIES = int(os.environ.get("BENCH_SERVE_QUERIES", "256"))
+
+
+def _percentile(xs: list[float], q: float) -> float:
+    xs = sorted(xs)
+    i = min(len(xs) - 1, int(round(q * (len(xs) - 1))))
+    return xs[i]
+
+
+def run(scale: int = SCALE, edgefactor: int = EDGEFACTOR,
+        width: int = WIDTH, nqueries: int = NQUERIES,
+        grid_shape=(2, 4), kinds=("bfs", "pagerank")) -> dict:
+    import numpy as np
+
+    from combblas_tpu import obs
+    from combblas_tpu.parallel.grid import Grid
+    from combblas_tpu.serve import (
+        BackpressureError, GraphEngine, ServeConfig,
+    )
+    from combblas_tpu.utils.rmat import rmat_symmetric_coo_host
+
+    sidecar = obs.enable_sidecar("serve")
+
+    n = 1 << scale
+    rows, cols = rmat_symmetric_coo_host(42, scale, edgefactor)
+    grid = Grid.make(*grid_shape)
+
+    # raw COO straight in: from_coo deduplicates internally (one
+    # int64-key unique pass — doing it here too would double the sort)
+    t0 = time.perf_counter()
+    engine = GraphEngine.from_coo(grid, rows, cols, n, kinds=kinds)
+    load_s = time.perf_counter() - t0
+
+    # mixed query stream: alternating kinds over random reachable roots
+    # (raw rows give the same reachable set as the deduped edge list)
+    deg = np.bincount(rows, minlength=n)
+    rng = np.random.default_rng(7)
+    roots = rng.choice(np.flatnonzero(deg > 0), size=nqueries)
+    stream = [
+        (kinds[i % len(kinds)], int(r)) for i, r in enumerate(roots)
+    ]
+
+    # plans for every bucket the server may flush under, plus width-1
+    # for the baseline — after this, ZERO traces is the contract
+    widths = tuple(sorted({1, width}))
+    t0 = time.perf_counter()
+    engine.warmup(kinds=kinds, widths=widths)
+    warmup_s = time.perf_counter() - t0
+    mark = engine.trace_mark()
+
+    # -- baseline: one warm call per query --------------------------------
+    t0 = time.perf_counter()
+    for kind, root in stream:
+        engine.execute(kind, np.asarray([root], np.int32))
+    base_s = time.perf_counter() - t0
+    qps_base = nqueries / base_s
+
+    # -- batched serving ---------------------------------------------------
+    cfg = ServeConfig(
+        lane_widths=(width,),  # the acceptance gate's fixed bucket
+        max_queue=max(4 * width, nqueries),
+        max_wait_s=0.05,
+    )
+    lat: list[float] = []
+
+    def _stamp(ts):
+        # completion-time stamping: measuring at result()-collection
+        # time would charge a fast request for an earlier slow batch
+        return lambda _f: lat.append(time.monotonic() - ts)
+
+    t0 = time.perf_counter()
+    with engine.serve(cfg) as srv:
+        submitted = []
+        for kind, root in stream:
+            f = srv.submit(kind, root)
+            f.add_done_callback(_stamp(time.monotonic()))
+            submitted.append(f)
+        for f in submitted:
+            f.result(timeout=600)
+    batch_s = time.perf_counter() - t0
+    qps_batch = nqueries / batch_s
+    stats = srv.stats()
+
+    retraces = engine.retraces_since(mark)
+
+    # -- backpressure gate: a full queue rejects, never blocks -------------
+    tiny = engine.serve(ServeConfig(
+        lane_widths=(width,), max_queue=4, max_wait_s=30.0,
+    ))  # worker NOT started: the queue cannot drain
+    backpressure_ok = False
+    retry_after = None
+    try:
+        for i in range(8):
+            tiny.scheduler.submit("bfs", int(roots[0]))
+    except BackpressureError as e:
+        backpressure_ok = True
+        retry_after = e.retry_after_s
+    tiny.scheduler.fail_pending(RuntimeError("bench probe teardown"))
+
+    speedup = qps_batch / qps_base if qps_base else float("inf")
+    out = {
+        "metric": "serve_throughput",
+        "unit": "queries/s",
+        "value": round(qps_batch, 2),
+        "qps_batched": round(qps_batch, 2),
+        "qps_baseline": round(qps_base, 2),
+        "speedup": round(speedup, 2),
+        "p50_ms": round(1e3 * _percentile(lat, 0.50), 2),
+        "p99_ms": round(1e3 * _percentile(lat, 0.99), 2),
+        "width": width,
+        "nqueries": nqueries,
+        "kinds": list(kinds),
+        "scale": scale,
+        "grid": list(grid_shape),
+        "edges_raw": int(len(rows)),  # pre-dedup (from_coo dedups)
+        "load_s": round(load_s, 2),
+        "warmup_s": round(warmup_s, 2),
+        "mean_occupancy": stats["mean_occupancy"],
+        "batches": stats["batches"],
+        "retraces_after_warmup": retraces,
+        "backpressure_ok": backpressure_ok,
+        "backpressure_retry_after_s": retry_after,
+        "ok": bool(
+            speedup >= 4.0 and retraces == 0 and backpressure_ok
+        ),
+    }
+    obs.gauge("serve.bench.qps_batched", qps_batch)
+    obs.gauge("serve.bench.qps_baseline", qps_base)
+    obs.gauge("serve.bench.speedup", speedup)
+    if sidecar:
+        try:
+            out["obs_jsonl"] = obs.dump_jsonl()
+        except Exception as e:  # telemetry must never fail the bench
+            out["obs_error"] = str(e)
+    return out
+
+
+def main():
+    out = run()
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()

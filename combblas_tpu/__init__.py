@@ -61,6 +61,11 @@ from .semantic import SemanticGraph, filtered_bfs, filtered_mis
 # docs/observability.md. Zero-cost when disabled (the default).
 from . import obs
 
+# Query serving (GraphEngine + batched, backpressured Server); see
+# docs/serving.md. Pure host-side layering over models/parallel —
+# importing it costs nothing until an engine is built.
+from . import serve
+
 __version__ = "0.1.0"
 
 __all__ = [
@@ -82,4 +87,6 @@ __all__ = [
     "SemanticGraph", "filtered_bfs", "filtered_mis",
     # telemetry
     "obs",
+    # query serving
+    "serve",
 ]

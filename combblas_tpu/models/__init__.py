@@ -1,0 +1,13 @@
+"""Application suite (≈ Applications/): BFS, SSSP, PageRank, BC, CC, TC,
+MCL, MIS, matchings, orderings — plus the shared batch-lane conventions
+the query-serving subsystem (``combblas_tpu.serve``) builds on.
+"""
+
+#: Lane-padding sentinel for every batched multi-root kernel
+#: (``bfs.bfs_batch``, ``bfs.bfs_batch_compact``, ``sssp.sssp_batch``,
+#: ``pagerank.pagerank_batch``, ``bc.bc_batch_dense_lanes``): a source
+#: slot holding PAD_ROOT is an INERT lane — it discovers nothing,
+#: carries zero rank/mass, and its outputs are undefined-but-harmless
+#: (callers must drop pad lanes, which ``serve.batcher`` does). The
+#: value is negative so it can never collide with a vertex id.
+PAD_ROOT: int = -1

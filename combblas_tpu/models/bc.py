@@ -151,8 +151,26 @@ def bc_batch_dense(E, ET, sources, max_depth: int | None = None):
     )
 
 
-@partial(jax.jit, static_argnames=("max_depth",))
-def _bc_batch_dense_impl(E, ET, sources, max_depth: int | None = None):
+def bc_batch_dense_lanes(E, ET, sources, max_depth: int | None = None):
+    """Per-lane Brandes dependencies: the [n, W] delta matrix BEFORE the
+    cross-lane sum — lane k is the single-source dependency vector of
+    ``sources[k]`` (what a serve request for one root wants back).
+    ``models.PAD_ROOT`` source slots yield all-zero lanes. Summing the
+    lanes reproduces ``bc_batch_dense`` exactly.
+    """
+    from ..parallel.vec import DistMultiVec
+
+    delta = _bc_batch_dense_impl(
+        E, ET, sources, max_depth=max_depth, per_lane=True
+    )
+    return DistMultiVec(
+        blocks=delta, length=E.nrows, align="row", grid=E.grid
+    )
+
+
+@partial(jax.jit, static_argnames=("max_depth", "per_lane"))
+def _bc_batch_dense_impl(E, ET, sources, max_depth: int | None = None,
+                         per_lane: bool = False):
     """Batched Brandes in ONE compiled program over dense [n, W] state.
 
     The host-loop ``bc_batch`` mirrors the reference's
@@ -178,7 +196,15 @@ def _bc_batch_dense_impl(E, ET, sources, max_depth: int | None = None):
     D = max_depth if max_depth is not None else n
 
     gids = DistVec.iota(grid, n, jnp.int32, align="row").blocks  # [pr, lr]
-    is_src = gids[..., None] == sources[None, None, :]
+    # models.PAD_ROOT lanes are inert (all-zero dependencies — the
+    # serve batcher's lane padding). The iota gid table pads with ids
+    # >= n so PAD_ROOT can never match, but the explicit guard keeps
+    # the contract independent of the gid-table padding convention
+    # (the -1-padded _global_ids tables WOULD match).
+    from . import PAD_ROOT
+
+    live = sources[None, None, :] != PAD_ROOT
+    is_src = (gids[..., None] == sources[None, None, :]) & live
     lvl0 = jnp.where(is_src, 0, -1).astype(jnp.int32)
     nsp0 = is_src.astype(E.dtype)
 
@@ -227,6 +253,10 @@ def _bc_batch_dense_impl(E, ET, sources, max_depth: int | None = None):
         start, depth, bstep, jnp.zeros_like(nsp0)
     )
     # endpoints excluded: zero each lane's own source slot, sum lanes
+    # (``per_lane=True`` skips the sum — the serve path hands each lane
+    # back to its own request)
     delta = jnp.where(is_src, 0, delta)
+    if per_lane:
+        return delta
     total = jnp.sum(delta, axis=-1)
     return total

@@ -26,6 +26,7 @@ from functools import lru_cache, partial
 import jax
 import jax.numpy as jnp
 
+from . import PAD_ROOT
 from .. import obs
 from ..semiring import PLUS_TIMES, SELECT2ND_MAX
 from ..parallel.spmat import SpParMat, ones_i32
@@ -554,15 +555,20 @@ def _bfs_batch_impl(
     col_gids = _global_ids(grid, pc_, lc, A.ncols, "col")
 
     src = sources.astype(jnp.int32)[None, None, :]  # [1, 1, W]
-    parents0 = jnp.where(
-        row_gids[:, :, None] == src, src, jnp.int32(-1)
-    )  # [pr, lr, W]
+    # PAD_ROOT lanes (the serve batcher's lane padding) are inert: the
+    # live guard keeps a pad source from matching the -1 padding slots
+    # of the gid tables, so a pad lane starts (and stays) empty.
+    live = src != PAD_ROOT
+    is_src = (row_gids[:, :, None] == src) & live
+    parents0 = jnp.where(is_src, src, jnp.int32(-1))  # [pr, lr, W]
     levels0 = (
-        jnp.where(row_gids[:, :, None] == src, 0, -1).astype(jnp.int32)
+        jnp.where(is_src, 0, -1).astype(jnp.int32)
         if track_levels
         else jnp.zeros((1, 1, 1), jnp.int32)  # placeholder carry
     )
-    x0 = jnp.where(col_gids[:, :, None] == src, src, jnp.int32(-1))
+    x0 = jnp.where(
+        (col_gids[:, :, None] == src) & live, src, jnp.int32(-1)
+    )
 
     def mk(b, align):
         return DistMultiVec(blocks=b, length=n, align=align, grid=grid)
@@ -1164,11 +1170,15 @@ def _bfs_batch_compact_impl(A, sources, max_iters: int | None = None,
     row_gids = _global_ids(grid, pr_, lr, n, "row")
     col_gids = _global_ids(grid, pc_, lc, A.ncols, "col")
     src = sources.astype(jnp.int32)[None, None, :]
+    # PAD_ROOT lanes stay empty (see _bfs_batch_impl's live guard)
+    live = src != PAD_ROOT
 
     levels0 = jnp.where(
-        row_gids[:, :, None] == src, 0, -1
+        (row_gids[:, :, None] == src) & live, 0, -1
     ).astype(jnp.int8)  # [pr, lr, W]
-    x0 = (col_gids[:, :, None] == src).astype(jnp.int8)  # [pc, lc, W]
+    x0 = ((col_gids[:, :, None] == src) & live).astype(
+        jnp.int8
+    )  # [pc, lc, W]
 
     def mk(b, align):
         return DistMultiVec(blocks=b, length=n, align=align, grid=grid)
@@ -1230,7 +1240,9 @@ def _bfs_batch_compact_impl(A, sources, max_iters: int | None = None,
     levels_col = mk(levels, "row").realign("col").blocks
     parents = _ell_parents_from_levels(A, levels_col, levels)
     # roots are their own parents; undiscovered stay -1
-    parents = jnp.where(row_gids[:, :, None] == src, src, parents)
+    parents = jnp.where(
+        (row_gids[:, :, None] == src) & live, src, parents
+    )
     parents = jnp.where(
         (levels < 0) | (row_gids[:, :, None] < 0), -1, parents
     )

@@ -15,6 +15,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
+from . import PAD_ROOT
 from ..semiring import PLUS_TIMES
 from ..parallel.spmat import SpParMat, ones_f32
 from ..parallel.spmv import dist_spmv
@@ -128,9 +129,10 @@ def _pagerank_batch_impl(
     ``P_ell``: the COLUMN-NORMALIZED transition matrix as an EllParMat
     (entry (i,j) = 1/outdeg(j) for edge j->i — normalize host-side while
     building the ELL buckets). ``sources``: [W] int32 personalization
-    vertices. ``dangling``: col-aligned 0/1 DistVec marking zero-outdegree
-    columns. Returns (row-aligned DistMultiVec of ranks [n, W] — each lane
-    sums to 1, teleporting to ITS source — and the iteration count).
+    vertices; slots holding ``models.PAD_ROOT`` are inert padding lanes
+    (all-zero ranks — the serve batcher's lane padding). Returns
+    (row-aligned DistMultiVec of ranks [n, W] — each live lane sums to
+    1, teleporting to ITS source — and the iteration count).
 
     Reference: ``PageRank.cpp:126-157``'s loop, batched; personalization
     follows the standard PPR formulation (teleport to e_s instead of 1/n).
@@ -143,7 +145,13 @@ def _pagerank_batch_impl(
     W = sources.shape[0]
 
     row_gids = DistVec.iota(grid, n, jnp.int32, align="row").blocks  # [pr, lr]
-    e_s = (row_gids[..., None] == sources[None, None, :]).astype(jnp.float32)
+    # PAD_ROOT lanes get an all-zero teleport vector: they carry no mass
+    # and converge immediately (the iota gid table never holds PAD_ROOT,
+    # but the explicit guard keeps the contract independent of that)
+    live = (sources[None, None, :] != PAD_ROOT)
+    e_s = (
+        (row_gids[..., None] == sources[None, None, :]) & live
+    ).astype(jnp.float32)
     dang_row = dangling.realign("row").blocks  # [pr, lr]
     rowvalid = (row_gids < n)[..., None]
 

@@ -102,8 +102,14 @@ def _sssp_batch_impl(E, sources):
     inf = MIN_PLUS.zero(dtype)
 
     gids = DistVec.iota(grid, n, jnp.int32, align="row").blocks  # [pr, lr]
+    # models.PAD_ROOT lanes are inert padding (all-inf distances — the
+    # serve batcher's lane padding); same guard as _bfs_batch_impl
+    from . import PAD_ROOT
+
+    live = sources[None, None, :] != PAD_ROOT
     d0 = jnp.where(
-        gids[..., None] == sources[None, None, :], jnp.zeros((), dtype), inf
+        (gids[..., None] == sources[None, None, :]) & live,
+        jnp.zeros((), dtype), inf,
     )
 
     def mk(blocks):

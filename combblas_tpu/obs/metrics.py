@@ -40,13 +40,11 @@ class MetricsRegistry:
         self._counters: dict[tuple, float] = {}
         self._gauges: dict[tuple, float] = {}
         self._hists: dict[tuple, list] = {}  # [count, sum, min, max]
-        self._labels: dict[tuple, dict] = {}  # key -> labels dict
 
     def _key(self, name: str, labels: dict) -> tuple:
-        key = (name, _label_key(labels))
-        if labels and key not in self._labels:
-            self._labels[key] = dict(labels)
-        return key
+        # labels live inside the key (sorted tuple); snapshot()
+        # reconstructs the dict from it
+        return (name, _label_key(labels))
 
     # -- writers -----------------------------------------------------------
     def count(self, name: str, value=1, **labels):
@@ -114,4 +112,3 @@ class MetricsRegistry:
             self._counters.clear()
             self._gauges.clear()
             self._hists.clear()
-            self._labels.clear()

@@ -1,0 +1,37 @@
+"""``combblas_tpu.serve`` — batched, backpressured graph-query serving
+on a warm mesh.
+
+The kernel library answers "how fast is one batch"; this subsystem
+answers "how do a million independent single-root queries BECOME
+batches". Four layers (docs/serving.md has the full architecture):
+
+1. **engine** (`engine.py`) — ``GraphEngine``: one loaded graph
+   (EllParMat + weighted/normalized/transposed twins, CSC companion,
+   degree vectors) and a shape-bucketed plan cache, pre-warmed by
+   ``warmup()`` so steady-state requests never trace or compile.
+2. **batcher** (`batcher.py`) — lane-bucket assembly: coalesce
+   single-root BFS/SSSP/PageRank/BC requests into the nearest
+   power-of-two lane width, pad with ``models.PAD_ROOT``, scatter
+   per-lane results back to request futures (pad lanes can never leak).
+3. **scheduler** (`scheduler.py`) — bounded queue with
+   reject-with-retry-after admission control, per-kind flush deadlines,
+   per-request timeouts, and per-request error isolation.
+4. **api** (`api.py`) — ``Server``: ``submit()/submit_many()/stats()``
+   plus the single worker thread that owns the execution stream.
+
+Everything is wired into ``combblas_tpu.obs`` (queue-depth gauge,
+occupancy/padding-waste/latency histograms, plan-cache and
+``trace.serve`` counters) and measured by ``benchmarks/serve_bench.py``
+against the one-call-per-query baseline.
+"""
+
+from .batcher import Request, assemble, bucket_width, scatter
+from .engine import KINDS, GraphEngine
+from .scheduler import BackpressureError, Scheduler, ServeConfig
+from .api import Server
+
+__all__ = [
+    "GraphEngine", "Server", "ServeConfig", "Scheduler",
+    "BackpressureError", "Request", "KINDS",
+    "bucket_width", "assemble", "scatter",
+]

@@ -1,0 +1,247 @@
+"""Server — the worker loop tying engine + scheduler + batcher together.
+
+``submit()`` returns a ``concurrent.futures.Future`` immediately; a
+single background worker thread owns ALL device execution (one
+execution stream, like one TPU), waking on submissions and flush
+deadlines, popping ready batches, padding them into lane buckets, and
+scattering lane results back to futures. ``submit_many`` is the bulk
+front door; ``stats()`` surfaces queue depth, batch occupancy, plan
+cache and trace counts without needing obs enabled.
+
+Usage::
+
+    engine = GraphEngine.from_coo(grid, rows, cols, n)
+    with engine.serve(ServeConfig(lane_widths=(1, 4, 16))) as srv:
+        srv.warmup()                      # pre-trace every lane bucket
+        f = srv.submit("bfs", root=7)
+        print(f.result()["levels"][:10])
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+import traceback
+from concurrent.futures import Future
+
+from .. import obs
+from . import batcher
+from .scheduler import BackpressureError, Scheduler, ServeConfig
+
+
+class Server:
+    """In-process query server over one ``GraphEngine``."""
+
+    def __init__(self, engine, config: ServeConfig | None = None):
+        self.engine = engine
+        self.config = config or ServeConfig()
+        self.scheduler = Scheduler(
+            self.config, engine.nrows, engine.kinds()
+        )
+        self._wake = threading.Condition()
+        self._stop = False
+        self._worker: threading.Thread | None = None
+        self.batches = 0
+        self.completed = 0
+        self.worker_errors = 0
+        self.last_worker_error: Exception | None = None
+        self._occupancy_sum = 0.0
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def warmup(self, kinds=None, widths=None) -> dict:
+        """Warm every (kind, lane width) plan the configured buckets can
+        produce — after this, steady-state serving never traces."""
+        return self.engine.warmup(
+            kinds=kinds,
+            widths=tuple(widths or self.config.lane_widths),
+        )
+
+    def start(self) -> "Server":
+        if self.scheduler.closed:
+            # close() is final (admissions are refused forever); a
+            # restarted worker could never receive work
+            raise RuntimeError(
+                "serve.Server is closed; build a new one via "
+                "engine.serve()"
+            )
+        if self._worker is None or not self._worker.is_alive():
+            self._stop = False
+            self._worker = threading.Thread(
+                target=self._loop, name="combblas-serve", daemon=True
+            )
+            self._worker.start()
+        return self
+
+    def close(self, drain: bool = True, timeout: float = 30.0) -> None:
+        """Close the front door (subsequent submits raise — a closed
+        server must never strand a future) and stop the worker;
+        ``drain=True`` executes everything still queued first (in the
+        CALLER's thread, after the worker has joined — so it also
+        drains a server whose worker was never started), else pending
+        requests fail with a shutdown error."""
+        self.scheduler.close()  # admissions refused from here on
+        with self._wake:
+            self._stop = True
+            self._wake.notify_all()
+        if self._worker is not None:
+            self._worker.join(timeout)
+            if self._worker.is_alive():
+                # the engine has ONE execution thread; draining from
+                # this thread while the worker still runs would race
+                # it — surface the stuck worker instead
+                raise TimeoutError(
+                    f"serve worker did not stop within {timeout}s; "
+                    "queue not drained (call close() again later)"
+                )
+            self._worker = None
+        if drain:
+            while self.scheduler.depth():
+                self.pump(force=True)
+        else:
+            self.scheduler.fail_pending(
+                RuntimeError("serve.Server closed without drain")
+            )
+
+    def __enter__(self) -> "Server":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- front door --------------------------------------------------------
+
+    def submit(self, kind: str, root, timeout_s: float | None = None
+               ) -> Future:
+        """Admit one single-root query. Raises ``BackpressureError``
+        when the bounded queue is full (reject + retry-after, never
+        unbounded blocking); malformed roots come back as failed
+        futures (error isolation — see scheduler.submit)."""
+        fut = self.scheduler.submit(kind, root, timeout_s=timeout_s)
+        with self._wake:
+            self._wake.notify_all()
+        return fut
+
+    def submit_many(self, kind: str, roots, timeout_s: float | None = None
+                    ) -> list[Future]:
+        """Bulk submit; stops at the first backpressure rejection and
+        fails the REMAINING requests' futures with it (the caller sees
+        exactly which prefix was admitted — one future per root, in
+        order, generators included)."""
+        roots = list(roots)  # single materialization: generator-safe
+        out: list[Future] = []
+        for i, r in enumerate(roots):
+            try:
+                out.append(
+                    self.scheduler.submit(kind, r, timeout_s=timeout_s)
+                )
+            except (BackpressureError, RuntimeError) as e:
+                # backpressure OR a concurrent close(): either way the
+                # caller must still get one future per root — the
+                # admitted prefix's results stay reachable
+                for _ in roots[i:]:
+                    f = Future()
+                    f.set_exception(
+                        BackpressureError(
+                            self.scheduler.depth(), e.retry_after_s
+                        )
+                        if isinstance(e, BackpressureError) else e
+                    )
+                    out.append(f)
+                break
+        with self._wake:
+            self._wake.notify_all()
+        return out
+
+    # -- worker ------------------------------------------------------------
+
+    def _execute_batches(self, ready) -> None:
+        for reqs in ready:
+            # whole-batch guard: these requests are already popped, so
+            # ANY failure (assemble, engine, scatter) must settle their
+            # futures — a stranded future blocks its caller forever
+            try:
+                sources = batcher.assemble(
+                    reqs, self.config.lane_widths
+                )
+                self.batches += 1
+                self._occupancy_sum += len(reqs) / len(sources)
+                result = self.engine.execute(reqs[0].kind, sources)
+                self.completed += batcher.scatter(reqs, result)
+            except Exception as e:  # failure fails THIS batch only
+                batcher.fail(reqs, e)
+
+    def pump(self, force: bool = False) -> int:
+        """One synchronous scheduling step (the worker's body, callable
+        directly for deterministic tests / worker-less embedding):
+        execute every batch currently due. Returns batches executed."""
+        ready = self.scheduler.pop_ready(force=force)
+        self._execute_batches(ready)
+        return len(ready)
+
+    def _loop(self) -> None:
+        while True:
+            with self._wake:
+                if self._stop:
+                    break
+            # pump BEFORE sleeping: requests that arrived while the
+            # previous batch executed (their notify found no waiter)
+            # may already fill a lane bucket — flush-on-full must not
+            # wait out the deadline
+            try:
+                if self.pump():
+                    continue
+            except Exception as e:  # the worker must outlive any one
+                # pump: a dead worker with an open front door would
+                # admit requests whose futures never complete. The
+                # error is RETAINED and printed — an obs counter alone
+                # would vanish with telemetry off (the default)
+                self.worker_errors += 1
+                self.last_worker_error = e
+                obs.count("serve.worker.errors")
+                traceback.print_exc(file=sys.stderr)
+                time.sleep(0.05)
+                continue
+            with self._wake:
+                if self._stop:
+                    break
+                if self.scheduler.has_ready():
+                    # a burst landed between pump() returning and this
+                    # lock acquire (its notify found no waiter): flush
+                    # now instead of sleeping out the deadline. Checked
+                    # under _wake, so later submits cannot be missed —
+                    # their notify blocks until wait() releases it.
+                    continue
+                deadline = self.scheduler.next_deadline()
+                if deadline is None:
+                    # idle: block until a submit/close notifies (no
+                    # polling — notify cannot be missed, it needs this
+                    # lock, held until wait() releases it)
+                    self._wake.wait()
+                else:
+                    delay = deadline - time.monotonic()
+                    if delay > 0:
+                        self._wake.wait(delay)
+        # drain happens in close(), after this thread has joined — one
+        # executor at a time, and a never-started worker drains too
+
+    # -- introspection -----------------------------------------------------
+
+    def stats(self) -> dict:
+        s = self.engine.stats()
+        s.update(
+            queue_depth=self.scheduler.depth(),
+            submitted=self.scheduler.submitted,
+            rejected=self.scheduler.rejected,
+            batches=self.batches,
+            completed=self.completed,
+            worker_errors=self.worker_errors,
+            mean_occupancy=(
+                self._occupancy_sum / self.batches if self.batches else None
+            ),
+            lane_widths=list(self.config.lane_widths),
+            max_queue=self.config.max_queue,
+        )
+        obs.gauge("serve.batches", self.batches)
+        return s

@@ -1,0 +1,462 @@
+"""GraphEngine — a loaded graph plus a shape-bucketed warm plan cache.
+
+The batch kernels make TPUs pay off only when (a) requests share one
+launch and (b) the launched executable already exists. The engine owns
+both halves for one graph:
+
+* the loaded matrices and derived artifacts: the structural
+  ``EllParMat`` (BFS/BC/PageRank-structure), its weighted twin (SSSP),
+  the column-normalized PageRank transition matrix + dangling vector,
+  the transpose (BC on directed graphs) and the row/column degree
+  vectors (``coldeg``) — built host-side once at load, uploaded once;
+  the CSC companion tiers (``csc_companion()``, the future
+  sparse-regime hook) build lazily on first use;
+* a **plan cache** keyed by (query kind, lane width): each plan is one
+  jitted program whose trace increments both a host-side counter and
+  the ``trace.serve`` obs counter (trace-time side effects count
+  RETRACES, not executions — the zero-retrace acceptance gate), so
+  ``warmup()`` over the configured lane buckets guarantees steady-state
+  requests never trace or compile.
+
+The engine is synchronous and thread-safe: plan building, ``warmup``
+and ``execute`` serialize on one internal lock (one execution stream —
+a caller-thread ``warmup()`` cannot race the api worker's batches);
+results come back as HOST numpy arrays, so ``execute`` is the
+device→host sync point.
+On readback-poisoned chips run the engine in a dedicated serving
+process, exactly like bench children (bench.py's axon D2H note).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+
+import numpy as np
+
+from .. import obs
+from ..models import PAD_ROOT
+
+#: Query kinds the engine can build plans for.
+KINDS = ("bfs", "sssp", "pagerank", "bc")
+
+
+@dataclasses.dataclass
+class _Plan:
+    """One warm executable: (kind, width) -> jitted program + metadata."""
+
+    kind: str
+    width: int
+    fn: object  # jitted callable
+    traces: int = 0  # incremented at TRACE time (retrace counter)
+    executions: int = 0
+
+
+class GraphEngine:
+    """One graph, loaded and query-ready. See module docstring.
+
+    Build with ``GraphEngine.from_coo`` (host COO in the usual gather
+    orientation: entry (i, j) means edge j -> i; symmetrize for
+    undirected graphs). ``serve()`` wraps the engine in the batched,
+    backpressured server (``combblas_tpu.serve.api.Server``).
+    """
+
+    def __init__(self, grid, E, *, nrows: int, deg: np.ndarray,
+                 E_weighted=None, P_ell=None, dangling=None, ET=None,
+                 csc=None, coldeg=None, kinds: tuple[str, ...] | None = None,
+                 pagerank_opts: tuple = (0.85, 1e-6, 100),
+                 max_iters: int | None = None):
+        self.grid = grid
+        self.E = E
+        self.nrows = int(nrows)
+        self.deg = np.asarray(deg)
+        weighted_given = E_weighted is not None
+        self.E_weighted = E_weighted if E_weighted is not None else E
+        self.P_ell = P_ell
+        self.dangling = dangling
+        self.ET = ET if ET is not None else E  # symmetric default
+        self.csc = csc
+        self.coldeg = coldeg
+        # kinds this engine was built to serve: only these get plans —
+        # a kind whose artifacts were never built must be rejected at
+        # the front door, not served with a silently-wrong stand-in
+        # (no P_ell -> no pagerank; no weighted matrix -> no sssp, hop
+        # counts are not distances; explicit kinds= opts back in)
+        if kinds is None:
+            kinds = tuple(
+                k for k in KINDS
+                if (k != "pagerank" or P_ell is not None)
+                and (k != "sssp" or weighted_given)
+            )
+        self._kinds = tuple(kinds)
+        self.pagerank_opts = pagerank_opts
+        self.max_iters = max_iters
+        self._host_coo: tuple | None = None
+        self._plans: dict[tuple[str, int], _Plan] = {}
+        # ONE execution stream: plan building, warmup, and execute all
+        # serialize here, so a caller-thread warmup() cannot race the
+        # api worker's pump() on the plan cache (or the device)
+        self._exec_lock = threading.RLock()
+        # plan-cache DICT mutations/snapshots only — stats() must be
+        # pollable during a long batch, so it must not touch _exec_lock
+        self._plans_lock = threading.Lock()
+        self.plan_hits = 0
+        self.plan_misses = 0
+
+    # -- construction ------------------------------------------------------
+
+    @staticmethod
+    def from_coo(grid, rows, cols, nrows: int, ncols: int | None = None,
+                 weights=None, kinds: tuple[str, ...] | None = None,
+                 pagerank_alpha: float = 0.85, pagerank_tol: float = 1e-6,
+                 pagerank_max_iters: int = 100,
+                 max_iters: int | None = None,
+                 symmetric: bool = True,
+                 keep_coo: bool = False) -> "GraphEngine":
+        """Load a graph from host COO and build every derived artifact
+        the requested ``kinds`` need (one host pass + one upload each —
+        the kernel-1 role, amortized over the engine's whole lifetime).
+
+        ``kinds`` defaults to every kind whose inputs were actually
+        given: without ``weights``, 'sssp' is EXCLUDED (serving hop
+        counts as "distances" would be a silent stand-in) — pass
+        ``kinds`` naming it explicitly to serve unit-weight SSSP on a
+        genuinely unweighted graph.
+
+        The COO is DEDUPLICATED here (generators like
+        ``rmat_symmetric_coo`` emit repeats, and a duplicate edge would
+        silently act as weight-2 in BC's path counting); duplicate
+        weighted edges keep the MINIMUM weight (the shortest-path
+        natural combine, matching the reference's dedup-at-construction
+        convention, ``SpParMat.from_global_coo dedup_sr=``).
+        """
+        from ..parallel.ellmat import EllParMat
+        from ..parallel.vec import DistVec
+
+        rows = np.asarray(rows)
+        cols = np.asarray(cols)
+        ncols = nrows if ncols is None else int(ncols)
+        n = int(nrows)
+        if kinds is None:
+            kinds = tuple(
+                k for k in KINDS
+                if (k != "sssp" or weights is not None)
+                and (k != "bc" or ncols == n)  # bc needs a square graph
+            )
+        key = rows.astype(np.int64) * np.int64(ncols) + cols
+        uniq, inv = np.unique(key, return_inverse=True)
+        if weights is not None:
+            w = np.full(len(uniq), np.inf, np.float32)
+            np.minimum.at(w, inv, np.asarray(weights, np.float32))
+            weights = w
+        rows = (uniq // ncols).astype(rows.dtype)
+        cols = (uniq % ncols).astype(cols.dtype)
+        if "bc" in kinds and symmetric:
+            # VERIFY the symmetry claim instead of trusting it: under
+            # symmetric=True bc reuses E as its own transpose, and a
+            # forgotten symmetric=False would make every served score
+            # silently wrong (the backward sweep would walk out-edges)
+            tkey = np.sort(
+                cols.astype(np.int64) * np.int64(ncols) + rows
+            )
+            if ncols != n or not np.array_equal(uniq, tkey):
+                raise ValueError(
+                    "symmetric=True but the COO is not structurally "
+                    "symmetric; pass symmetric=False (builds the "
+                    "transpose for bc) or symmetrize the graph"
+                )
+        with obs.span("serve.load", nrows=n, nnz=int(len(rows))):
+            ones = np.ones(len(rows), np.float32)
+            E = EllParMat.from_host_coo(grid, rows, cols, ones, n, ncols)
+            E_weighted = (
+                EllParMat.from_host_coo(
+                    grid, rows, cols,
+                    np.asarray(weights, np.float32), n, ncols,
+                )
+                if weights is not None else None
+            )
+            # degree artifacts: rowdeg = in-edges per row; outdeg feeds
+            # the pagerank normalization and the lazy coldeg_vec()
+            # (device upload deferred until a plan consumes it)
+            deg = np.bincount(rows, minlength=n).astype(np.int32)
+            outdeg = np.bincount(cols, minlength=ncols).astype(np.int64)
+            P_ell = dangling = None
+            if "pagerank" in kinds:
+                # column-stochastic normalization, host-side (the
+                # reference's DimApply, PageRank.cpp:97-126)
+                pvals = (
+                    1.0 / np.maximum(outdeg[cols], 1)
+                ).astype(np.float32)
+                P_ell = EllParMat.from_host_coo(
+                    grid, rows, cols, pvals, n, ncols
+                )
+                dangling = DistVec.from_global(
+                    grid, (outdeg == 0).astype(np.float32), align="col"
+                )
+            ET = None
+            if "bc" in kinds and not symmetric:
+                ET = EllParMat.from_host_coo(grid, cols, rows, ones,
+                                             ncols, n)
+        eng = GraphEngine(
+            grid, E, nrows=n, deg=deg, E_weighted=E_weighted,
+            P_ell=P_ell, dangling=dangling, ET=ET,
+            kinds=tuple(kinds),
+            pagerank_opts=(pagerank_alpha, pagerank_tol,
+                           pagerank_max_iters),
+            max_iters=max_iters,
+        )
+        eng._outdeg = outdeg  # host [ncols] — feeds lazy coldeg_vec()
+        if keep_coo:
+            eng._host_coo = (rows, cols, ncols)  # lazy CSC-tier builds
+        return eng
+
+    def coldeg_vec(self):
+        """Col-aligned out-degree DistVec (the budget input of the
+        direction-optimized kernels) — built lazily like
+        ``csc_companion``: no current dense plan consumes it, so the
+        device upload is deferred to first use and cached."""
+        if self.coldeg is None:
+            outdeg = getattr(self, "_outdeg", None)
+            if outdeg is None:
+                raise ValueError(
+                    "coldeg_vec needs the degree table: build the "
+                    "engine with GraphEngine.from_coo"
+                )
+            from ..parallel.vec import DistVec
+
+            self.coldeg = DistVec.from_global(
+                self.grid, outdeg.astype(np.int32), align="col"
+            )
+        return self.coldeg
+
+    def csc_companion(self):
+        """The CSC companion tiers (``ellmat.build_csc_companion``) —
+        the direction-optimization hook for future sparse-regime serve
+        plans. Built LAZILY on first use (it is dead weight for the
+        dense batch kernels the current plans run) and cached; needs
+        the host COO, so it requires ``from_coo(..., keep_coo=True)``
+        (opt-in: retaining the edge list costs ~8 bytes/nnz of host RAM
+        for the engine's lifetime). The COO is released after the
+        build — the companion caches, the edge list does not linger.
+        """
+        if self.csc is None:
+            if self._host_coo is None:
+                raise ValueError(
+                    "csc_companion needs the host COO: build the "
+                    "engine with GraphEngine.from_coo(keep_coo=True)"
+                )
+            from ..parallel.ellmat import build_csc_companion
+
+            rows, cols, ncols = self._host_coo
+            self.csc = build_csc_companion(
+                self.grid, rows, cols, self.nrows, ncols
+            )
+            self._host_coo = None  # companion built: drop the edge list
+        return self.csc
+
+    def serve(self, config=None):
+        from .api import Server
+        from .scheduler import ServeConfig
+
+        return Server(self, config or ServeConfig())
+
+    # -- plan cache --------------------------------------------------------
+
+    def kinds(self) -> tuple[str, ...]:
+        """The kinds this engine was BUILT to serve — a kind outside
+        this set is rejected (its artifacts may not exist: e.g. ET for
+        BC on a directed graph), never served with a stand-in."""
+        return self._kinds
+
+    def plan(self, kind: str, width: int) -> _Plan:
+        """The warm executable for (kind, width) — built (a cache MISS,
+        which traces and possibly compiles) only on first use; warm it
+        via ``warmup()`` so serving never misses."""
+        if kind not in self._kinds:
+            raise ValueError(
+                f"engine was not built for kind {kind!r} "
+                f"(kinds={self._kinds})"
+            )
+        key = (kind, int(width))
+        with self._exec_lock:
+            with self._plans_lock:
+                p = self._plans.get(key)
+            if p is not None:
+                self.plan_hits += 1
+                obs.count("serve.plan_cache.hits", kind=kind, width=width)
+                return p
+            self.plan_misses += 1
+            obs.count("serve.plan_cache.misses", kind=kind, width=width)
+            p = self._build_plan(kind, int(width))
+            with self._plans_lock:
+                self._plans[key] = p
+            return p
+
+    def _build_plan(self, kind: str, width: int) -> _Plan:
+        import jax
+
+        from ..models.bc import _bc_batch_dense_impl
+        from ..models.bfs import _bfs_batch_impl
+        from ..models.pagerank import _pagerank_batch_impl
+        from ..models.sssp import _sssp_batch_impl
+
+        plan = _Plan(kind=kind, width=width, fn=None)
+
+        def trace_mark():
+            # runs at TRACE time only: counts (re)traces, not executions
+            plan.traces += 1
+            obs.count("trace.serve", kind=kind, width=width)
+
+        if kind == "bfs":
+
+            def impl(E, sources):
+                trace_mark()
+                return _bfs_batch_impl(
+                    E, sources, max_iters=self.max_iters,
+                )
+
+            args = (self.E,)
+        elif kind == "sssp":
+
+            def impl(E, sources):
+                trace_mark()
+                return _sssp_batch_impl(E, sources)
+
+            args = (self.E_weighted,)
+        elif kind == "pagerank":
+            if self.P_ell is None:
+                raise ValueError(
+                    "engine was built without the pagerank artifacts "
+                    "(kinds= did not include 'pagerank')"
+                )
+            alpha, tol, iters = self.pagerank_opts
+
+            def impl(P, dangling, sources):
+                trace_mark()
+                return _pagerank_batch_impl(
+                    P, sources, dangling, alpha=alpha, tol=tol,
+                    max_iters=iters,
+                )
+
+            args = (self.P_ell, self.dangling)
+        elif kind == "bc":
+
+            def impl(E, ET, sources):
+                trace_mark()
+                return _bc_batch_dense_impl(
+                    E, ET, sources, max_depth=self.max_iters,
+                    per_lane=True,
+                )
+
+            args = (self.E, self.ET)
+        else:
+            raise ValueError(f"unknown query kind {kind!r}")
+
+        jitted = jax.jit(impl)
+        plan.fn = lambda sources: jitted(*args, sources)
+        return plan
+
+    def warmup(self, kinds: tuple[str, ...] | None = None,
+               widths: tuple[int, ...] = (1, 2, 4, 8, 16)) -> dict:
+        """Pre-trace/compile every (kind, width) plan by executing it
+        once on an all-``PAD_ROOT`` batch (inert lanes: the program
+        shape is identical, the search trivially empty) and blocking.
+        After this, serving a request mix that stays inside ``kinds`` x
+        ``widths`` performs ZERO traces — assert via
+        ``retraces_since(mark)`` or the ``trace.serve`` obs counter.
+        Returns {(kind, width): seconds}.
+        """
+        import jax
+
+        kinds = self.kinds() if kinds is None else kinds
+        out = {}
+        for kind in kinds:
+            for w in widths:
+                t0 = time.perf_counter()
+                with self._exec_lock, obs.span(
+                    "serve.warmup", kind=kind, width=int(w)
+                ):
+                    res = self.plan(kind, w).fn(
+                        np.full(int(w), PAD_ROOT, np.int32)
+                    )
+                    jax.block_until_ready(res)
+                out[(kind, int(w))] = time.perf_counter() - t0
+        return out
+
+    def trace_mark(self) -> int:
+        """Total traces across all plans (snapshot before serving, then
+        ``retraces_since`` asserts the zero-retrace contract)."""
+        return sum(p.traces for p in self._plans.values())
+
+    def retraces_since(self, mark: int) -> int:
+        return self.trace_mark() - mark
+
+    # -- execution ---------------------------------------------------------
+
+    def _lanes_to_global(self, blocks) -> np.ndarray:
+        """[pa, L, W] device blocks -> [n, W] host array (the engine's
+        device->host sync) — via ``DistMultiVec.to_global`` so the
+        block-layout knowledge stays in exactly one place."""
+        from ..parallel.vec import DistMultiVec
+
+        return DistMultiVec(
+            blocks=blocks, length=self.nrows, align="row", grid=self.grid
+        ).to_global()
+
+    def execute(self, kind: str, sources) -> dict:
+        """Run one batch: ``sources`` is the int32 lane vector (pad
+        slots = ``PAD_ROOT``). Returns a dict of host arrays with the
+        lane axis LAST (what ``batcher.scatter`` slices per request).
+        """
+        import jax.numpy as jnp
+
+        sources = np.asarray(sources, np.int32)
+        W = sources.shape[0]
+        plan = self.plan(kind, W)
+        with self._exec_lock, obs.span("serve.batch", kind=kind, width=W):
+            res = plan.fn(jnp.asarray(sources))
+            plan.executions += 1
+            # "batch_niter" is BATCH metadata (the max iteration count
+            # over all lanes, pad included), not a per-request fact: a
+            # request's own value would vary with its batch-mates
+            if kind == "bfs":
+                p, l, niter = res
+                return {
+                    "parents": self._lanes_to_global(p),
+                    "levels": self._lanes_to_global(l),
+                    "batch_niter": int(niter),
+                }
+            if kind == "sssp":
+                d, niter = res
+                return {
+                    "dist": self._lanes_to_global(d),
+                    "batch_niter": int(niter),
+                }
+            if kind == "pagerank":
+                x, niter = res
+                return {
+                    "ranks": self._lanes_to_global(x),
+                    "batch_niter": int(niter),
+                }
+            # bc: per-lane Brandes dependency vectors
+            return {"scores": self._lanes_to_global(res)}
+
+    def stats(self) -> dict:
+        # _plans_lock only: polling stats during a long batch must not
+        # block on the device-holding execution lock
+        with self._plans_lock:
+            plans = {
+                f"{k}/{w}": {
+                    "traces": p.traces, "executions": p.executions,
+                }
+                for (k, w), p in sorted(self._plans.items())
+            }
+            hits, misses = self.plan_hits, self.plan_misses
+        return {
+            "plans": plans,
+            "plan_hits": hits,
+            "plan_misses": misses,
+            "nrows": self.nrows,
+            "kinds": list(self.kinds()),
+        }

@@ -1,0 +1,291 @@
+"""Admission control + flush policy: the backpressured front door.
+
+A bounded pending queue with reject-with-retry-after admission (a full
+queue REFUSES work instead of buffering unboundedly — the load-shedding
+half of a serving stack), per-kind deadline-driven flushing (a batch
+goes out when it fills its widest lane bucket OR its oldest request has
+waited ``max_wait_s``), per-request timeouts, and error isolation: a
+malformed root fails ITS future at admission and never contaminates a
+batch.
+
+Thread-safe; the api-layer worker loop drives ``pop_ready`` /
+``next_deadline``. Everything here is host-side bookkeeping — no JAX in
+this module.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+
+from .. import obs
+from .batcher import Request, settle
+
+
+class BackpressureError(RuntimeError):
+    """Queue full: the caller should back off and retry.
+
+    ``retry_after_s`` is the server's hint — one flush deadline, i.e.
+    when capacity is next expected to free up.
+    """
+
+    def __init__(self, depth: int, retry_after_s: float):
+        super().__init__(
+            f"serve queue full ({depth} pending); retry after "
+            f"{retry_after_s:.3f}s"
+        )
+        self.retry_after_s = retry_after_s
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Policy knobs for one server instance.
+
+    ``lane_widths``: ascending shape buckets a flush may compile/execute
+    under (every width here should be covered by ``warmup()`` so
+    steady-state serving never traces). ``max_wait_s``: flush deadline —
+    the latency a lonely request pays waiting for lane-mates;
+    ``per_kind_max_wait`` overrides it per query kind. ``max_queue``
+    bounds TOTAL pending requests across kinds (admission control).
+    """
+
+    lane_widths: tuple[int, ...] = (1, 2, 4, 8, 16)
+    max_queue: int = 1024
+    max_wait_s: float = 0.01
+    per_kind_max_wait: dict | None = None
+    default_timeout_s: float | None = None
+
+    def __post_init__(self):
+        if (
+            not self.lane_widths
+            or tuple(sorted(self.lane_widths)) != tuple(self.lane_widths)
+            or self.lane_widths[0] < 1
+        ):
+            raise ValueError(
+                "lane_widths must be ascending positive ints"
+            )
+
+    def wait_for(self, kind: str) -> float:
+        if self.per_kind_max_wait and kind in self.per_kind_max_wait:
+            return self.per_kind_max_wait[kind]
+        return self.max_wait_s
+
+
+class Scheduler:
+    """Pending-request store with admission control and flush policy."""
+
+    def __init__(self, config: ServeConfig, nrows: int,
+                 kinds: tuple[str, ...]):
+        self.config = config
+        self.nrows = nrows
+        self.kinds = kinds
+        self._pending: dict[str, deque[Request]] = {
+            k: deque() for k in kinds
+        }
+        self._rid = itertools.count()
+        self._lock = threading.Lock()
+        self._closed = False
+        self.rejected = 0
+        self.submitted = 0
+
+    def close(self) -> None:
+        """Refuse all further admissions, PERMANENTLY (set under the
+        admission lock, so a submit racing ``Server.close`` either
+        lands before the drain or raises — it can never be silently
+        stranded)."""
+        with self._lock:
+            self._closed = True
+
+    @property
+    def closed(self) -> bool:
+        with self._lock:
+            return self._closed
+
+    # -- admission ---------------------------------------------------------
+
+    def depth(self) -> int:
+        with self._lock:
+            return sum(len(q) for q in self._pending.values())
+
+    def submit(self, kind: str, root, timeout_s: float | None = None,
+               now: float | None = None) -> Future:
+        """Admit one single-root query; returns its Future.
+
+        Raises ``BackpressureError`` when the queue is full and
+        ``ValueError`` for an unknown kind (caller bugs, not load). A
+        MALFORMED ROOT is isolated instead: its future carries the
+        ValueError and the request never enters a batch.
+        """
+        if kind not in self._pending:
+            raise ValueError(
+                f"unknown query kind {kind!r}; engine serves {self.kinds}"
+            )
+        with self._lock:  # closed check FIRST: close semantics must not
+            # depend on whether the root happened to be malformed
+            if self._closed:
+                raise RuntimeError(
+                    "serve.Server is closed; no further admissions"
+                )
+        now = time.monotonic() if now is None else now
+        fut: Future = Future()
+        timeout_s = (
+            timeout_s if timeout_s is not None
+            else self.config.default_timeout_s
+        )
+        deadline = None if timeout_s is None else now + timeout_s
+        # error isolation: a bad root fails its OWN request, not a batch
+        try:
+            root_i = int(root)
+            if root_i != root or not (0 <= root_i < self.nrows):
+                raise ValueError(
+                    f"root {root!r} outside [0, {self.nrows})"
+                )
+        except (TypeError, ValueError) as e:
+            fut.set_exception(
+                e if isinstance(e, ValueError) else ValueError(str(e))
+            )
+            obs.count("serve.requests", kind=kind, status="invalid")
+            return fut
+        with self._lock:
+            if self._closed:  # re-check: close() may have raced the
+                # host-side validation above
+                raise RuntimeError(
+                    "serve.Server is closed; no further admissions"
+                )
+            d = sum(len(q) for q in self._pending.values())
+            if d >= self.config.max_queue:
+                self.rejected += 1
+                obs.count("serve.queue.rejected", kind=kind)
+                raise BackpressureError(d, self.config.wait_for(kind))
+            req = Request(
+                rid=next(self._rid), kind=kind, root=root_i, future=fut,
+                submitted_at=now, deadline=deadline,
+            )
+            self._pending[kind].append(req)
+            self.submitted += 1
+            obs.gauge("serve.queue.depth", d + 1)
+        return fut
+
+    # -- flush policy ------------------------------------------------------
+
+    def _dispatch_by(self, kind: str, r: Request) -> float:
+        """Latest time ``r`` should enter a batch: its kind's flush
+        deadline, tightened for short per-request timeouts — a request
+        whose timeout is under 2x the kind's max-wait dispatches at
+        HALF its timeout budget (half for queueing, half for
+        execution), instead of being slept past and expired in queue."""
+        wait = self.config.wait_for(kind)
+        if r.deadline is None:
+            return r.submitted_at + wait
+        budget = (r.deadline - r.submitted_at) / 2
+        return r.submitted_at + min(wait, budget)
+
+    def _kind_deadline(self, kind: str, q) -> float:
+        """When this kind must flush: the earliest dispatch-by time of
+        any queued request. An O(queue-depth) scan, bounded by
+        ``max_queue`` (default 1024 — microseconds of host arithmetic
+        next to a device batch); track incrementally if max_queue ever
+        grows by orders of magnitude."""
+        return min(self._dispatch_by(kind, r) for r in q)
+
+    def next_deadline(self) -> float | None:
+        """Absolute time of the earliest pending flush, or None when
+        idle (see ``_kind_deadline`` for what counts as a deadline)."""
+        with self._lock:
+            deadlines = [
+                self._kind_deadline(k, q)
+                for k, q in self._pending.items() if q
+            ]
+        return min(deadlines) if deadlines else None
+
+    def has_ready(self, now: float | None = None) -> bool:
+        """True when some kind is flushable RIGHT NOW (full widest
+        bucket or dispatch deadline reached) — the worker checks this
+        under its wake lock before sleeping, closing the window where a
+        burst's notify lands while no one is waiting."""
+        now = time.monotonic() if now is None else now
+        wmax = self.config.lane_widths[-1]
+        with self._lock:
+            return any(
+                q and (
+                    len(q) >= wmax or now >= self._kind_deadline(k, q)
+                )
+                for k, q in self._pending.items()
+            )
+
+    def pop_ready(self, now: float | None = None,
+                  force: bool = False) -> list[list[Request]]:
+        """Batches due for execution: a kind flushes when it can fill
+        the widest lane bucket, when its oldest request has aged past
+        the kind's flush deadline, or unconditionally under ``force``
+        (drain/close). Expired requests are timed out here, before
+        batching. Returns a list of per-kind request lists (each at most
+        the widest bucket — a deep backlog flushes over several calls).
+        """
+        now = time.monotonic() if now is None else now
+        wmax = self.config.lane_widths[-1]
+        out: list[list[Request]] = []
+        timed_out: list[Request] = []
+        with self._lock:
+            for kind, q in self._pending.items():
+                # full-queue sweep for DEAD requests — expired (even
+                # BEHIND a fresh head) or client-cancelled: neither may
+                # ride into a batch and waste a device lane or trigger
+                # a premature flush; any() guards the rebuild off the
+                # common all-live path. Expired requests are only
+                # COLLECTED here — settling runs done-callbacks
+                # synchronously, and a callback that re-enters submit()
+                # would deadlock on this non-reentrant lock
+                def dead(r):
+                    return r.expired(now) or r.future.done()
+
+                if any(dead(r) for r in q):
+                    live = [r for r in q if not dead(r)]
+                    for req in q:
+                        if req.future.done():  # client cancel/settle
+                            obs.count(
+                                "serve.requests", kind=kind,
+                                status="cancelled",
+                            )
+                        elif req.expired(now):
+                            timed_out.append(req)
+                    q.clear()
+                    q.extend(live)
+                while q and (
+                    force
+                    or len(q) >= wmax
+                    or now >= self._kind_deadline(kind, q)
+                ):
+                    take = min(len(q), wmax)
+                    out.append([q.popleft() for _ in range(take)])
+            obs.gauge(
+                "serve.queue.depth",
+                sum(len(q) for q in self._pending.values()),
+            )
+        for req in timed_out:  # settle OUTSIDE the lock (see above)
+            settle(req.future, exc=TimeoutError(
+                f"request {req.rid} ({req.kind} root={req.root}) "
+                "expired in queue"
+            ))
+            obs.count("serve.requests", kind=req.kind, status="timeout")
+        return out
+
+    def drain(self) -> list[list[Request]]:
+        """Everything still pending, as batches (close/shutdown path)."""
+        return self.pop_ready(force=True)
+
+    def fail_pending(self, exc: Exception) -> None:
+        """Fail every queued request (server shutdown without drain).
+        Settlement happens after the lock is released — done-callbacks
+        run synchronously and may re-enter the scheduler."""
+        drained: list[Request] = []
+        with self._lock:
+            for q in self._pending.values():
+                while q:
+                    drained.append(q.popleft())
+        for req in drained:
+            settle(req.future, exc=exc)

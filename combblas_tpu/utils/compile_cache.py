@@ -5,10 +5,20 @@ cold-process recompile). One definition so the official bench and every
 probe measure under identical cache behavior; ``BENCH_NOCACHE=1``
 disables for diagnostics.
 
+IDEMPOTENCE CONTRACT: the cache dir is process-global jax config, so
+the first ``enable_compile_cache`` call wins. Re-enabling with no
+argument ("ensure the cache is on") or with the SAME (resolved) dir is
+a no-op; an EXPLICIT different dir raises — silently retargeting the
+cache mid-process would split compiled artifacts across two dirs and
+make hit/miss counters unattributable. ``_reset_for_tests()`` is the
+explicit test-only escape hatch.
+
 When telemetry is on (``combblas_tpu.obs``), enabling the cache also
 installs the jax.monitoring bridge so persistent-cache hits/misses
 surface as the ``compile_cache.hits`` / ``compile_cache.misses``
-counters in every report/JSONL dump.
+counters, and registers a pull-provider publishing the
+``compile_cache.entries`` gauge (files currently in the cache dir) into
+every report/JSONL dump.
 """
 
 from __future__ import annotations
@@ -21,8 +31,34 @@ CACHE_DIR = os.path.normpath(
     os.path.join(os.path.dirname(__file__), "..", "..", ".jax_cache")
 )
 
+#: The dir the process committed to on the first successful enable call
+#: (None = not yet enabled). See the idempotence contract above.
+_configured_dir: str | None = None
+
+
+def configured_dir() -> str | None:
+    """The cache dir this process committed to, or None when the cache
+    was never enabled — the public accessor (the underlying global is
+    an internal invariant of the idempotence contract)."""
+    return _configured_dir
+
+
+def _record_cache_entries() -> None:
+    """obs provider: persistent-cache entry count, polled at export time
+    (a push on every compile would race the async cache writer)."""
+    if _configured_dir is None:
+        return
+    try:
+        entries = sum(
+            1 for e in os.scandir(_configured_dir) if e.is_file()
+        )
+    except OSError:
+        entries = 0
+    obs.gauge("compile_cache.entries", entries, dir=_configured_dir)
+
 
 def enable_compile_cache(cache_dir: str | None = None) -> None:
+    global _configured_dir
     import jax
 
     if obs.ENABLED:
@@ -30,8 +66,31 @@ def enable_compile_cache(cache_dir: str | None = None) -> None:
     if os.environ.get("BENCH_NOCACHE") == "1":
         obs.count("compile_cache.disabled")
         return
-    jax.config.update(
-        "jax_compilation_cache_dir", cache_dir or CACHE_DIR
-    )
+    # abspath: "cache" and os.path.abspath("cache") are the same dir,
+    # and the committed identity must not drift under a later chdir
+    resolved = os.path.abspath(cache_dir or CACHE_DIR)
+    if _configured_dir is not None:
+        # cache_dir=None means "ensure enabled", not "move to the
+        # default dir" — every argless caller (bench.py, probes) must
+        # keep working after someone committed a custom dir
+        if cache_dir is None or resolved == _configured_dir:
+            return  # idempotent re-enable
+        raise ValueError(
+            f"compile cache already enabled at {_configured_dir!r}; "
+            f"cannot retarget to {resolved!r} in the same process "
+            "(jax_compilation_cache_dir is process-global — see the "
+            "idempotence contract in utils/compile_cache.py)"
+        )
+    jax.config.update("jax_compilation_cache_dir", resolved)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    _configured_dir = resolved
+    obs.register_provider(_record_cache_entries)
+
+
+def _reset_for_tests() -> None:
+    """Forget the committed cache dir (TEST-ONLY: lets a test exercise
+    the idempotence contract without poisoning the process for later
+    callers — restore the prior value afterwards)."""
+    global _configured_dir
+    _configured_dir = None

@@ -25,6 +25,15 @@ import numpy as np
 import pytest
 
 
+def pytest_configure(config):
+    # "slow" keeps stress/latency tests out of the tier-1 budget
+    # (ROADMAP.md runs `-m 'not slow'`); registered here since the repo
+    # carries no pytest.ini.
+    config.addinivalue_line(
+        "markers", "slow: excluded from the tier-1 `-m 'not slow'` run"
+    )
+
+
 @pytest.fixture(scope="session", autouse=True)
 def _devices():
     assert len(jax.devices()) == 8, jax.devices()

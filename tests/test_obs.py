@@ -338,7 +338,12 @@ def test_obs_smoke_bench_trace_matches_schema(tmp_path):
             cache_dir=str(tmp_path / "cache"),
         )
     finally:
-        # undo the smoke run's global compile-cache redirection
+        # undo the smoke run's global compile-cache redirection —
+        # including the idempotence guard's committed dir, or a later
+        # same-process enable_compile_cache() would refuse to run
+        from combblas_tpu.utils import compile_cache as _cc
+
+        _cc._reset_for_tests()
         jax.config.update("jax_compilation_cache_dir", None)
     recs = obs.parse_jsonl(path)  # schema-validates every line
     agg = obs.aggregate(recs)
