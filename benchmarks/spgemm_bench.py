@@ -16,18 +16,26 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 SCALE = int(os.environ.get("BENCH_SCALE", "14"))
 REPS = int(os.environ.get("BENCH_REPS", "3"))
-KERNEL = os.environ.get("BENCH_KERNEL", "esc")  # esc | mxu | scan | scanphased
+# esc | mxu | scan | scanphased | windowed | auto  (auto = the tier
+# router's choice, sized host-side like every other kernel here)
+KERNEL = os.environ.get("BENCH_KERNEL", "esc")
 PHASES = int(os.environ.get("BENCH_PHASES", "8"))  # scanphased only
 OCAP = os.environ.get("BENCH_OCAP")  # override out_capacity (mxu sparsify
 # cost scales with it: searchsorted queries per slot; scan: accumulator
 # slots — sized from the exact host symbolic out-nnz when unset)
+# BENCH_GOLDEN=1 (default): after timing, verify the result EXACTLY
+# against the scipy A² golden (nnz and integer count values) — the same
+# golden the ESC path is validated against, so agreement here is
+# agreement with ESC. =0 skips (saves the host product + readback).
+GOLDEN = os.environ.get("BENCH_GOLDEN", "1") == "1"
+BLOCK_ROWS = int(os.environ.get("BENCH_BLOCK_ROWS", "0"))  # windowed tier
 
 
 def main():
     import jax
     import numpy as np
 
-    from combblas_tpu import PLUS_TIMES
+    from combblas_tpu import PLUS_TIMES, obs
     from combblas_tpu.parallel.grid import Grid
     from combblas_tpu.parallel.spgemm import (
         summa_capacities_host,
@@ -36,6 +44,11 @@ def main():
     )
     from combblas_tpu.parallel.spmat import SpParMat
     from combblas_tpu.utils.rmat import rmat_symmetric_coo_host
+
+    # BENCH_OBS=1: per-process JSONL sidecar (the bench.py convention) —
+    # carries the tier-router counters (spgemm.auto.tier,
+    # spgemm.windowed.windows_skipped, spgemm.auto.mask_density)
+    obs.enable_sidecar(f"spgemm-{KERNEL}")
 
     grid = Grid.make(1, 1)
     n = 1 << SCALE
@@ -57,7 +70,22 @@ def main():
     fcap, ocap = summa_capacities_host(
         grid, ru, cu, ru, cu, n, n, n, per_stage=per_stage
     )
-    if KERNEL == "scan":
+    # BENCH_KERNEL=auto: resolve the router's tier HERE (host counts
+    # only — the axon D2H rule) and run that kernel below; the metric
+    # name keeps the requested "auto" and the JSON carries the tier.
+    kernel = KERNEL
+    tier = None
+    if KERNEL == "auto":
+        from combblas_tpu.parallel.spgemm import choose_tier_from_counts
+
+        lrA_, lcB_ = grid.local_rows(n), grid.local_cols(n)
+        tier = choose_tier_from_counts(
+            PLUS_TIMES, max(lrA_, lcB_), lrA_ * lcB_, grid.pr,
+            float(flops), backend="scatter",
+        )
+        obs.count("spgemm.auto.tier", tier=tier, sr="plus_times")
+        kernel = tier
+    if kernel == "scan":
         # exact output structure on host: out_capacity = nnz(A^2) — the
         # scan variant's accumulator scales with the OUTPUT, which is what
         # lets scale 16 fit in HBM (the round-2 all-stages-live ESC
@@ -83,7 +111,117 @@ def main():
     import jax.numpy as jnp
     from jax import lax
 
-    if KERNEL == "scanphased":
+    if kernel == "windowed":
+        # Round 6: the auto-tiered general sparse-output path. Sizing is
+        # HOST-ONLY (axon D2H rule): the row-block symbolic pass + plan
+        # come from the COO before any upload; "auto" additionally runs
+        # the router's gate over the same host counts and records the
+        # chosen tier through obs.
+        from combblas_tpu.parallel.spgemm import (
+            WINDOWED_CHUNK_W,
+            default_block_rows,
+            local_spgemm_windowed,
+            summa_rowblock_flops_host,
+            summa_spgemm_windowed,
+            windowed_plan,
+        )
+
+        lrA = grid.local_rows(n)
+        lcB = grid.local_cols(n)
+        # KERNEL=auto already resolved (and obs-counted) the tier above;
+        # a direct BENCH_KERNEL=windowed request is its own tier
+        tier = tier or "windowed"
+        block_rows = BLOCK_ROWS or default_block_rows(lrA, lcB)
+        pb = summa_rowblock_flops_host(
+            grid, ru, cu, ru, cu, n, n, n, block_rows,
+            chunk_w=WINDOWED_CHUNK_W,
+        )
+        pt = summa_rowblock_flops_host(
+            grid, ru, cu, ru, cu, n, n, n, block_rows, chunk_w=0
+        )
+        flop_caps, out_caps, skip = windowed_plan(
+            pb, pt, block_rows, lrA, lcB
+        )
+        obs.count("spgemm.windowed.windows_skipped", sum(skip))
+        obs.gauge("spgemm.windowed.blocks", len(skip))
+        # same quantity as the library emitter (parallel/spgemm.py:
+        # spgemm_windowed): raw symbolic output bound over dense cells
+        obs.gauge(
+            "spgemm.auto.mask_density",
+            float(np.asarray(pt).sum(axis=1).max(axis=(-1, -2)).sum())
+            / max(lrA * lcB, 1),
+        )
+
+        def mult(a):
+            # grid 1x1 here: the per-block-program fast path (the fused
+            # shard_map graph measures >2x slower on XLA:CPU)
+            if grid.size == 1:
+                return local_spgemm_windowed(
+                    PLUS_TIMES, a, a, block_rows=block_rows,
+                    flop_caps=flop_caps, out_caps=out_caps, skip=skip,
+                    chunk_w=WINDOWED_CHUNK_W,
+                )
+            return summa_spgemm_windowed(
+                PLUS_TIMES, a, a, block_rows=block_rows,
+                flop_caps=flop_caps, out_caps=out_caps, skip=skip,
+                backend="scatter", chunk_w=WINDOWED_CHUNK_W,
+            )
+
+        C, ov = mult(A)  # warmup/compile
+        jax.block_until_ready(C.vals)
+        time.sleep(3)
+        t0 = time.perf_counter()
+        for _ in range(REPS):
+            C, ov = mult(A)
+        nnz_v = int(jax.device_get(C.getnnz()))  # barrier
+        dt = time.perf_counter() - t0
+        out = {
+            "metric": f"spgemm_AxA_rmat_scale{SCALE}_{KERNEL}_MFLOPs",
+            "value": round(flops * 2 * REPS / dt / 1e6, 2),
+            "unit": "MFLOP/s",
+            "flops": int(flops),
+            "ms_per_spgemm": round(dt / REPS * 1e3, 2),
+            "out_nnz": nnz_v,
+            "overflow": int(jax.device_get(ov)),
+            "tier": tier,
+            "block_rows": block_rows,
+            "blocks": len(skip),
+            "windows_skipped": int(sum(skip)),
+        }
+        if GOLDEN:
+            # EXACT agreement with the A² golden: 0/1 adjacency counts
+            # are integers < 2^24, so the comparison is bit-exact — the
+            # same golden the ESC path reproduces (MultTest role).
+            from scipy import sparse
+
+            rr, cc, vv = (
+                np.asarray(jax.device_get(x))[0, 0]
+                for x in (C.rows, C.cols, C.vals)
+            )
+            live = rr < n
+            got = sparse.csr_matrix(
+                (vv[live], (rr[live], cc[live])), shape=(n, n)
+            )
+            got.sum_duplicates()
+            S = sparse.csr_matrix(
+                (np.ones(len(ru), np.float32), (ru, cu)), shape=(n, n)
+            )
+            P = S @ S
+            P.sort_indices()
+            got.sort_indices()
+            out["golden_nnz"] = int(P.nnz)
+            out["golden_nnz_match"] = bool(got.nnz == P.nnz)
+            out["golden_exact"] = bool(
+                got.nnz == P.nnz
+                and np.array_equal(got.indptr, P.indptr)
+                and np.array_equal(got.indices, P.indices)
+                and np.array_equal(got.data, P.data)
+            )
+        if obs.ENABLED:
+            out["obs_jsonl"] = obs.dump_jsonl()
+        print(json.dumps(out))
+        return
+    if kernel == "scanphased":
         # MemEfficientSpGEMM pattern at benchmark level: B's columns split
         # into flop-BALANCED phases (host symbolic), every phase runs the
         # output-bounded scan kernel with ONE shared capacity set (single
@@ -172,7 +310,7 @@ def main():
             )
         )
         return
-    if KERNEL == "scan":
+    if kernel == "scan":
         from combblas_tpu.parallel.spgemm import summa_spgemm_scan
 
         overflow_dev = None
@@ -199,7 +337,7 @@ def main():
         C, overflow_dev = summa_spgemm_scan(
             PLUS_TIMES, A, A, flop_capacity=fcap, out_capacity=ocap
         )
-    elif KERNEL == "mxu":
+    elif kernel == "mxu":
         from combblas_tpu.parallel.spgemm import summa_spgemm_mxu
 
         # round 4: bf16 stage products (13.3 TFLOP/s, exact for the 0/1
@@ -251,26 +389,27 @@ def main():
         _ = float(jax.device_get(out))  # barrier
         dt = time.perf_counter() - t0
         C = mult(A)
-    print(
-        json.dumps(
-            {
-                "metric": f"spgemm_AxA_rmat_scale{SCALE}_{KERNEL}_MFLOPs",
-                "value": round(flops * 2 * REPS / dt / 1e6, 2),
-                "unit": "MFLOP/s",
-                "flops": int(flops),
-                "ms_per_spgemm": round(dt / REPS * 1e3, 2),
-                "out_nnz": int(jax.device_get(C.getnnz())),
-                # nonzero = capacity truncated the product; numbers invalid
-                "overflow": (
-                    int(jax.device_get(mxu_overflow))
-                    if KERNEL == "mxu"
-                    else int(jax.device_get(overflow_dev))
-                    if KERNEL == "scan"
-                    else 0
-                ),
-            }
-        )
-    )
+    out = {
+        "metric": f"spgemm_AxA_rmat_scale{SCALE}_{KERNEL}_MFLOPs",
+        "value": round(flops * 2 * REPS / dt / 1e6, 2),
+        "unit": "MFLOP/s",
+        "flops": int(flops),
+        "ms_per_spgemm": round(dt / REPS * 1e3, 2),
+        "out_nnz": int(jax.device_get(C.getnnz())),
+        # nonzero = capacity truncated the product; numbers invalid
+        "overflow": (
+            int(jax.device_get(mxu_overflow))
+            if kernel == "mxu"
+            else int(jax.device_get(overflow_dev))
+            if kernel == "scan"
+            else 0
+        ),
+    }
+    from combblas_tpu import obs as _obs
+
+    if _obs.ENABLED:
+        out["obs_jsonl"] = _obs.dump_jsonl()
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -44,13 +44,16 @@ from .parallel.spgemm import (
     PhaseAdjustedWarning,
     block_spgemm,
     calculate_phases,
+    choose_spgemm_tier,
     estimate_flops,
     estimate_nnz_upper,
     mem_efficient_spgemm,
     spgemm,
     spgemm_auto,
     spgemm_scan,
+    spgemm_windowed,
     summa_spgemm_mxu,
+    summa_spgemm_windowed,
 )
 from .parallel.spmv import dist_spmspv, dist_spmv, dist_spmv_masked
 from .parallel.vec import DistMultiVec, concatenate
@@ -78,8 +81,10 @@ __all__ = [
     "Grid", "Grid3D", "SpParMat", "SpParMat3D", "DenseParMat", "EllParMat",
     "DistVec",
     # distributed algebra
-    "spgemm", "spgemm_scan", "spgemm_auto", "mem_efficient_spgemm",
-    "block_spgemm", "spgemm3d", "summa_spgemm_mxu", "PhaseAdjustedWarning",
+    "spgemm", "spgemm_scan", "spgemm_auto", "spgemm_windowed",
+    "choose_spgemm_tier", "mem_efficient_spgemm",
+    "block_spgemm", "spgemm3d", "summa_spgemm_mxu",
+    "summa_spgemm_windowed", "PhaseAdjustedWarning",
     "estimate_flops", "estimate_nnz_upper", "calculate_phases",
     "dist_spmv", "dist_spmv_masked", "dist_spmspv", "subsref", "spasgn",
     "concatenate", "DistMultiVec",

@@ -578,15 +578,22 @@ def _mcl_dense_loop(A, inflation, eps, max_iters, prune_kwargs,
                 perturb_kicks=kicks,
             )
 
-    cap = 1 << max(int(n) * min(select + 8, 64), 1024).bit_length()
-    for _ in range(6):
-        t, total = jax.jit(
-            lambda mm: sparsify_windowed(mm, 0.0, n, n, cap),
-            static_argnums=(),
-        )(m)
-        if int(total) <= cap:
-            break
-        cap = 1 << int(total * 1.05).bit_length()
+    # EXACT extraction sizing via the output-support oracle (round 6):
+    # one tiny readback of the converged state's support count replaces
+    # the former guess-and-retry loop (up to 6 grow-and-rerun extraction
+    # launches); this host loop already syncs on int(it) above, so the
+    # count costs no extra poison window.
+    from ..ops.spgemm import dense_support_nnz
+
+    nnz_exact = int(
+        jax.jit(dense_support_nnz, static_argnums=(2, 3))(m, 0.0, n, n)
+    )
+    cap = 1 << max(int(nnz_exact), 1024).bit_length()
+    t, total = jax.jit(
+        lambda mm: sparsify_windowed(mm, 0.0, n, n, cap),
+        static_argnums=(),
+    )(m)
+    assert int(total) == nnz_exact <= cap, (int(total), nnz_exact, cap)
     t = t.transpose()  # back from Aᵀ to A orientation
     out = SpParMat(
         rows=t.rows[None, None], cols=t.cols[None, None],

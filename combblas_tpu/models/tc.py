@@ -9,9 +9,19 @@ triangle {i>j>k} contributes C[i,j] += 1 via the wedge through k.
 
 from __future__ import annotations
 
+from functools import partial
+
 import jax
 import jax.numpy as jnp
+from jax import lax
 
+from .. import obs
+from ..ops.spgemm import (
+    combine_hilo,
+    coo_sort_dedup as _coo_sort_dedup,
+    pack_support_bits,
+    popcount_pair_counts,
+)
 from ..semiring import PLUS_TIMES
 from ..parallel.spgemm import spgemm, summa_spgemm
 from ..parallel.spmat import SpParMat, ones_f32
@@ -61,21 +71,8 @@ EDGE_HARVEST_MAX_DIM = 65536
 EDGE_HARVEST_BITS_MAX_DIM = 262144
 
 
-def _coo_sort_dedup(rows, cols):
-    """Stable two-key sort (rows major, cols minor) + adjacent-repeat
-    mask for a COO edge list — both edge-harvest kernels must group and
-    mask duplicated input entries on device (ADVICE r5). Returns the
-    reordered (rows, cols) and the per-slot ``dup`` mask (True on every
-    repeat after the first of a group)."""
-    order_c = jnp.argsort(cols, stable=True)
-    r1, c1 = rows[order_c], cols[order_c]
-    order_r = jnp.argsort(r1, stable=True)
-    rows, cols = r1[order_r], c1[order_r]
-    dup = jnp.concatenate([
-        jnp.zeros((1,), bool),
-        (rows[1:] == rows[:-1]) & (cols[1:] == cols[:-1]),
-    ])
-    return rows, cols, dup
+# _coo_sort_dedup now lives in ops/spgemm.py (coo_sort_dedup) — it is the
+# shared dedup front of every bit-packed kernel, imported above.
 
 
 def _tc_edge_harvest(rows, cols, n: int, chunk: int = 4096) -> jax.Array:
@@ -163,48 +160,116 @@ def _tc_edge_harvest_bits(rows, cols, n: int, chunk: int = 8192) -> jax.Array:
 
     Returns the (hi, lo) int32 split of 3·T like ``_tc_edge_harvest``.
     """
-    nw = -(-n // 32)
-    npad32 = nw * 32
     # ON-DEVICE DEDUP (duplicate COO entries would double-add a bit,
     # carrying into the NEXT bit and corrupting the adjacency — unlike
     # the idempotent .set of the bf16 variant): mask repeats, zero their
     # bit contribution AND their edge weight.
     rows, cols, dup = _coo_sort_dedup(rows, cols)
     loops = rows == cols
-    r_all = jnp.where(loops | dup, npad32, rows)  # dropped (mode="drop")
-    bits = jnp.zeros((npad32, nw), jnp.uint32)
-    bits = bits.at[r_all, cols >> 5].add(
-        (jnp.uint32(1) << (cols.astype(jnp.uint32) & 31)), mode="drop"
-    )
+    r_all = jnp.where(loops | dup, n, rows)  # dropped (mode="drop")
+    bits = pack_support_bits(r_all, cols, n, n, assume_unique=True)
     keep = (rows > cols) & ~dup
     nedge = rows.shape[0]
     epad = -(-nedge // chunk) * chunk
     er = jnp.pad(jnp.where(keep, rows, 0), (0, epad - nedge))
     ec = jnp.pad(jnp.where(keep, cols, 0), (0, epad - nedge))
     ew = jnp.pad(keep.astype(jnp.int32), (0, epad - nedge))
-
-    def body(carry, eidx):
-        hi, lo = carry
-        gi = bits[er[eidx]]  # [chunk, nw] u32
-        gj = bits[ec[eidx]]
-        pc = jax.lax.population_count(gi & gj)  # [chunk, nw] u32
-        cnt = jnp.sum(pc.astype(jnp.int32), axis=1) * ew[eidx]
-        lo = lo + jnp.sum(cnt & 0x7FFF)
-        hi = hi + jnp.sum(cnt >> 15) + (lo >> 15)
-        lo = lo & 0x7FFF
-        return (hi, lo), None
-
-    idx = jnp.arange(epad, dtype=jnp.int32).reshape(-1, chunk)
-    (hi, lo), _ = jax.lax.scan(body, (jnp.int32(0), jnp.int32(0)), idx)
-    return jnp.stack([hi, lo])
+    return popcount_pair_counts(bits, bits, er, ec, ew, chunk=chunk)
 
 
-def _tc_combine(hilo) -> int:
-    """Exact host-side total from ``_tc_dense``'s (hi, lo) split."""
-    import numpy as np
+#: Exact host-side total from a (hi, lo) split — shared with the other
+#: bit-packed kernels (ops/spgemm.py).
+_tc_combine = combine_hilo
 
-    hilo = np.asarray(hilo, np.int64)
-    return int((hilo[0] << 15) + hilo[1])
+
+@partial(jax.jit, static_argnames=("chunk",))
+def _tc_edge_harvest_dist(A: SpParMat, chunk: int = 8192) -> jax.Array:
+    """DISTRIBUTED bit-packed edge-harvest TC: the output-support oracle
+    tier on a p x p mesh.
+
+    Each device packs its tile of the symmetric adjacency into a
+    [local_rows, lc/32] bitmask over its own LOCAL columns, gathers the
+    packed tiles along its grid row and CONCATENATES them on the word
+    axis (column tiles cover disjoint, word-aligned global column
+    ranges — requires ``local_cols % 32 == 0``, which ``triangle_count``
+    enforces), and fetches its grid COLUMN's row-block mask from the
+    transpose-partner device with one ``ppermute`` (the mesh transpose,
+    SpParMat.transpose's route).  Every device then harvests ONLY ITS
+    OWN tile's strict-lower edges — the edge mask is already
+    distributed — with ``popcount_pair_counts`` over the two local
+    tables, and the (hi, lo) partial sums ``psum`` into the global
+    3·T count.  Local-column packing keeps the gather transient at the
+    table's own n²/(8p) bytes (packing full-width [lr, n/32] tiles and
+    OR-folding would transiently materialize p copies = n²/8 — the
+    single-shard footprint the distribution exists to avoid).
+    """
+    from ..parallel.grid import COL_AXIS, ROW_AXIS
+    from ..parallel.spmat import TILE_SPEC
+    from jax.sharding import PartitionSpec as P
+
+    grid = A.grid
+    p = grid.pr
+    assert grid.is_square, "edge-harvest TC needs a square grid"
+    n = A.nrows
+    lr, lc = A.local_rows, A.local_cols
+    assert lr == lc, "square blocking required (symmetric adjacency)"
+    assert lc % 32 == 0 or p == 1, (
+        f"distributed edge-harvest needs word-aligned column tiles "
+        f"(local_cols {lc} % 32 != 0); pad the matrix or use "
+        "kernel='sparse'"
+    )
+    nw_loc = -(-lc // 32)
+    cap = A.capacity
+    epad = -(-cap // chunk) * chunk
+
+    def body(ar, ac):
+        rows, cols = ar[0, 0], ac[0, 0]
+        ri = lax.axis_index(ROW_AXIS)
+        ci = lax.axis_index(COL_AXIS)
+        valid = rows < lr
+        grows = jnp.where(valid, rows + ri * lr, n)
+        gcols = jnp.where(valid, cols + ci * lc, n)
+        grows, gcols, dup = _coo_sort_dedup(grows, gcols)
+        loops = grows == gcols
+        # EXPLICIT drop mask, then localize: sentinel ARITHMETIC is a
+        # trap here — with ceil-blocking over-cover (n % lr != 0) the
+        # n-sentinel minus the last block's offset lands back INSIDE
+        # [0, lr), and pack's scatter-ADD would pile every padded slot
+        # onto one cell, carrying across bits.  Dropped slots get the
+        # row sentinel lr directly (>= nrows ⇒ pack drops them whatever
+        # their column).
+        drop = dup | loops | (grows >= n)
+        rloc = jnp.where(drop, lr, grows - ri * lr)
+        cloc = jnp.where(drop, lc, gcols - ci * lc)
+        bits_tile = pack_support_bits(
+            rloc, cloc, lr, nw_loc * 32, assume_unique=True
+        )
+        # concat along the word axis: grid-row tiles cover disjoint,
+        # word-aligned global column ranges (lc % 32 == 0), so the
+        # gathered [p, lr, nw_loc] blocks ARE the full row mask
+        g = lax.all_gather(bits_tile, COL_AXIS)
+        rowbits = jnp.transpose(g, (1, 0, 2)).reshape(lr, p * nw_loc)
+        # transpose partner: device (r, c) <- (c, r) row-block mask
+        colbits = lax.ppermute(
+            rowbits, (ROW_AXIS, COL_AXIS), grid.transpose_perm()
+        )
+        keep = (~dup) & (grows < n) & (grows > gcols)
+        er = jnp.where(keep, grows - ri * lr, 0)
+        ec = jnp.where(keep, gcols - ci * lc, 0)
+        ew = keep.astype(jnp.int32)
+        er = jnp.pad(er, (0, epad - cap))
+        ec = jnp.pad(ec, (0, epad - cap))
+        ew = jnp.pad(ew, (0, epad - cap))
+        hilo = popcount_pair_counts(rowbits, colbits, er, ec, ew, chunk=chunk)
+        return lax.psum(lax.psum(hilo, ROW_AXIS), COL_AXIS)
+
+    return jax.shard_map(
+        body,
+        mesh=grid.mesh,
+        in_specs=(TILE_SPEC,) * 2,
+        out_specs=P(),
+        check_vma=False,
+    )(A.rows, A.cols)
 
 
 def triangle_count(A: SpParMat, kernel: str = "auto") -> int:
@@ -216,15 +281,32 @@ def triangle_count(A: SpParMat, kernel: str = "auto") -> int:
     target chip the sparse masked SpGEMM pays the ~22 M/s random-memory
     wall (6.31 s at scale 14, PERF_NOTES_r3) while the dense product runs
     at 13.3 TFLOP/s and the mask removes any need for sparse extraction.
-    ``kernel="sparse"`` forces the distributed masked-SpGEMM path
-    (TC.cpp:104-116 flow) used for large or sharded inputs.
+    ``kernel="edgeharvest"`` (the bit-packed output-support tier) now
+    works on MULTI-DEVICE square grids too (round 6,
+    ``_tc_edge_harvest_dist``): per-device row-block bitmasks, OR along
+    grid rows, transpose-partner ppermute, psum'd popcount partials —
+    and "auto" picks it for sharded graphs within the n²/(8p) per-device
+    mask budget.  ``kernel="sparse"`` forces the distributed
+    masked-SpGEMM path (TC.cpp:104-116 flow), the fallback beyond the
+    mask budget and on non-square grids; NOTE it expects a deduplicated
+    edge list (values are wedge counts), while the harvest kernels
+    dedup on device.
     """
+    p = A.grid.pr
+    # distributed bitmask budget: two n²/(8p)-byte tables per device must
+    # fit the single-shard kernel's one-table HBM envelope
+    dist_bits_cap = int(EDGE_HARVEST_BITS_MAX_DIM * (p / 2) ** 0.5)
     if kernel == "auto":
         if A.grid.size == 1 and max(A.nrows, A.ncols) <= DENSE_MAX_DIM:
             kernel = "dense"
         elif (
             A.grid.size == 1
             and max(A.nrows, A.ncols) <= EDGE_HARVEST_BITS_MAX_DIM
+        ) or (
+            A.grid.size > 1
+            and A.grid.is_square
+            and A.local_cols % 32 == 0  # word-aligned tile concat
+            and max(A.nrows, A.ncols) <= dist_bits_cap
         ):
             kernel = "edgeharvest"
         else:
@@ -239,6 +321,24 @@ def triangle_count(A: SpParMat, kernel: str = "auto") -> int:
         "edgeharvest_bf16": _tc_edge_harvest,
     }
     if kernel in harvest:
+        if obs.ENABLED:
+            obs.count("spgemm.auto.tier", tier=kernel, sr="plus_times")
+        if A.grid.size > 1:
+            # the DISTRIBUTED oracle tier: only the bit-packed variant
+            # (the bf16 one has no distributed formulation — its gather
+            # traffic is the reason the bitmask exists)
+            if kernel != "edgeharvest":
+                raise ValueError(
+                    "distributed edge-harvest supports kernel="
+                    f"'edgeharvest' only, got {kernel}"
+                )
+            if max(A.nrows, A.ncols) > dist_bits_cap:
+                raise ValueError(
+                    "distributed edgeharvest needs two n^2/(8p)-byte "
+                    f"bitmasks per device: n <= {dist_bits_cap} on this "
+                    f"{p}x{p} grid, got {max(A.nrows, A.ncols)}"
+                )
+            return combine_hilo(_tc_edge_harvest_dist(A)) // 3
         cap = (
             EDGE_HARVEST_BITS_MAX_DIM if kernel == "edgeharvest"
             else EDGE_HARVEST_MAX_DIM

@@ -10,6 +10,27 @@ for the JSONL exporter and mergeable across processes.
 Everything here is plain host-side Python over dicts: no JAX arrays ever
 enter the registry (call sites convert to ``int``/``float`` first), so a
 metric can never smuggle a tracer or force a device sync.
+
+SpGEMM tier-router series (round 6 — the auto-tiered kernel ladder,
+docs/spgemm.md):
+
+==================================  =======  ==============================
+name                                kind     meaning
+==================================  =======  ==============================
+``spgemm.auto.tier``                counter  calls routed per tier; labels
+                                             ``tier`` (mxu / windowed /
+                                             scan / esc / edgeharvest) and
+                                             ``sr`` (semiring name)
+``spgemm.windowed.windows_skipped`` counter  row blocks skipped because the
+                                             symbolic pass proved them
+                                             empty (never scanned)
+``spgemm.windowed.blocks``          gauge    row blocks in the last plan
+``spgemm.auto.mask_density``        gauge    symbolic output-support bound
+                                             over dense cells (the oracle's
+                                             density estimate)
+``trace.summa_spgemm_windowed``     counter  kernel (re)traces, labeled by
+                                             accumulate ``backend``
+==================================  =======  ==============================
 """
 
 from __future__ import annotations

@@ -81,6 +81,18 @@ class CSR:
             nrows=self.nrows, ncols=self.ncols,
         )
 
+    def to_bitmask(self) -> Array:
+        """Packed [nrows, ceil(ncols/32)] uint32 support bitmask of the
+        tile — the output-support oracle's storage format (32x less
+        gather traffic than bool; see ops/spgemm.pack_support_bits).
+        CSR entries are unique by construction, so no dedup pass."""
+        from .spgemm import pack_support_bits
+
+        t = self.to_tuples()
+        return pack_support_bits(
+            t.rows, t.cols, self.nrows, self.ncols, assume_unique=True
+        )
+
 
 @partial(
     jax.tree_util.register_dataclass,
@@ -126,4 +138,16 @@ class CSC:
         return SpTuples(
             rows=self.indices, cols=cols, vals=self.vals, nnz=self.nnz,
             nrows=self.nrows, ncols=self.ncols,
+        )
+
+    def to_bitmask(self) -> Array:
+        """Packed [ncols, ceil(nrows/32)] uint32 COLUMN-support bitmask
+        (bit (j, i) set iff entry (i, j) exists) — the transpose-side
+        oracle table: pairing a CSR row mask with a CSC column mask makes
+        each output cell's support test one popcount (ops/spgemm)."""
+        from .spgemm import pack_support_bits
+
+        t = self.to_tuples()
+        return pack_support_bits(
+            t.cols, t.rows, self.ncols, self.nrows, assume_unique=True
         )

@@ -34,6 +34,20 @@ from .tuples import SpTuples
 
 Array = jax.Array
 
+#: Semiring add-monoid → XLA scatter combiner with a native lowering.
+#: The dense-accumulator SpGEMM tier folds expansion slots straight into a
+#: dense block with ``acc.at[idx].<combiner>`` — available exactly for the
+#: monoids XLA can scatter-combine (the same add_kind fast-path contract
+#: as ``ops/segment.py``); ``None`` means the tier must fall back to ESC.
+_SCATTER_COMBINERS = {"sum": "add", "min": "min", "max": "max"}
+
+
+def scatter_combine_for(sr: Semiring) -> str | None:
+    """Name of the ``jnp.ndarray.at[...]`` combiner implementing
+    ``sr.add`` (``"add"``/``"min"``/``"max"``), or None for generic
+    monoids (which need the order-respecting segmented reduction)."""
+    return _SCATTER_COMBINERS.get(sr.add_kind)
+
 
 def flops(a: SpTuples, b_csr: CSR) -> Array:
     """Scalar-multiply count of a·b (≈ estimateFLOP, mtSpGEMM.h:1058).
@@ -243,13 +257,23 @@ def sparsify_windowed(
     # 4.3 GB at scale 14.  Group counts therefore come from ONE MXU
     # matmul on the un-padded [nch, 128] layout, and the only [nch, 16]
     # arrays are two transients immediately flattened to 1-D tables.
-    mrow = mask.reshape(nch, 128).astype(jnp.bfloat16)
-    gsel = (
-        lax.broadcasted_iota(jnp.int32, (128, 16), 0) // 8
-        == lax.broadcasted_iota(jnp.int32, (128, 16), 1)
-    ).astype(jnp.bfloat16)
-    t8 = jnp.dot(mrow, gsel, preferred_element_type=jnp.float32)
-    t8 = t8.astype(jnp.int32)  # [nch, 16] group counts (exact: <= 8)
+    # On non-TPU backends the (8, 128) tiling does not exist and the
+    # matmul is the EXPENSIVE op (XLA:CPU has no MXU; an emulated-bf16
+    # dot dominated the windowed-tier extraction profile) — a plain
+    # reshape-sum computes the same [nch, 16] counts as one streaming
+    # pass there.
+    if jax.default_backend() == "tpu":
+        mrow = mask.reshape(nch, 128).astype(jnp.bfloat16)
+        gsel = (
+            lax.broadcasted_iota(jnp.int32, (128, 16), 0) // 8
+            == lax.broadcasted_iota(jnp.int32, (128, 16), 1)
+        ).astype(jnp.bfloat16)
+        t8 = jnp.dot(mrow, gsel, preferred_element_type=jnp.float32)
+        t8 = t8.astype(jnp.int32)  # [nch, 16] group counts (exact: <= 8)
+    else:
+        t8 = jnp.sum(
+            mask.reshape(nch, 16, 8).astype(jnp.int32), axis=-1
+        )  # [nch, 16] group counts (exact: <= 8)
     g8 = jnp.cumsum(t8, axis=1) - t8  # exclusive group prefix within chunk
     g8f = g8.reshape(-1)  # flat 1-D table: no lane padding
     tch = jnp.sum(t8, axis=1)  # [nch] chunk counts
@@ -359,3 +383,245 @@ def sparsify(
         ),
         total,
     )
+
+
+# --- dense-accumulator block kernel (the windowed mid-scale tier) -----------
+
+
+def accumulate_block_scatter(
+    sr: Semiring,
+    acc: Array,
+    a: SpTuples,
+    b_csr: CSR,
+    *,
+    row_lo: int,
+    flop_capacity: int,
+    chunk_w: int = 8,
+) -> Array:
+    """Fold one stage's expansion for output rows [row_lo, row_lo + Rb)
+    into the dense accumulator ``acc`` [Rb, pad_cols] with a single
+    semiring scatter — the sort-free ESC accumulate.
+
+    The classic ESC pays a (row, col) sort over EVERY expansion slot to
+    group duplicates; when the add monoid has a native scatter combiner
+    (``scatter_combine_for``), grouping is instead one ``at[].{add,min,
+    max}`` into a dense row block.  Expansion slots arrive row-major-ish
+    (they follow A's entry order), so the scatter's write set walks the
+    accumulator block-locally — on backends with cached scatter units
+    (XLA:CPU) this runs ~7x the fully-random scatter rate, and the sort
+    (the 87 s scale-16 ESC floor) disappears entirely.  On the target TPU
+    (no scatter unit, PERF_NOTES_r4) the caller uses the ``dot`` backend
+    instead; this function is the general-backend twin.
+
+    ``a`` must already be row-masked to the block (rows outside the block
+    carry the ``a.nrows`` sentinel): invalid slots produce flat indices
+    >= Rb * pad_cols and are dropped by the scatter.  ``chunk_w`` is the
+    expansion window width — the default 8 keeps slot padding ~1.1x for
+    R-MAT-like degree tails (the scatter pays per SLOT, so padding is
+    priced at full scatter cost here, unlike the gather-bound ESC
+    expansion where W=32 amortizes indices).
+    """
+    comb = scatter_combine_for(sr)
+    assert comb is not None, (
+        f"semiring {sr.name} (add_kind={sr.add_kind}) has no scatter "
+        "combiner; use the ESC path"
+    )
+    rb, pad_cols = acc.shape
+    t = expand(sr, a, b_csr, flop_capacity, chunk_w=chunk_w)
+    # invalid slots: rows == a.nrows >= row_lo + rb ⇒ flat >= rb*pad_cols
+    flat = (t.rows - row_lo) * pad_cols + t.cols
+    flat = jnp.where(t.valid_mask(), flat, rb * pad_cols)
+    upd = getattr(acc.reshape(-1).at[flat], comb)(
+        t.vals, mode="drop"
+    )
+    return upd.reshape(rb, pad_cols)
+
+
+def mask_rows(t: SpTuples, row_lo: int, row_hi: int) -> SpTuples:
+    """Entries with row outside [row_lo, row_hi) become padding (sentinel
+    indices) — the static row-block restriction of the windowed tier.
+    ``nnz`` is recomputed; capacity is untouched (static shapes)."""
+    import dataclasses
+
+    keep = t.valid_mask() & (t.rows >= row_lo) & (t.rows < row_hi)
+    return dataclasses.replace(
+        t,
+        rows=jnp.where(keep, t.rows, t.nrows),
+        cols=jnp.where(keep, t.cols, t.ncols),
+        nnz=jnp.sum(keep).astype(jnp.int32),
+    )
+
+
+# --- bit-packed output-support oracle ---------------------------------------
+
+
+def coo_sort_dedup(rows: Array, cols: Array) -> tuple[Array, Array, Array]:
+    """Stable two-key sort (rows major, cols minor) + adjacent-repeat
+    mask for a COO edge list.  Every bit-packed kernel must group and
+    mask duplicated input entries on device (a duplicate would double-ADD
+    a bit, carrying into the NEXT bit — ADVICE r5).  Returns the
+    reordered (rows, cols) and the per-slot ``dup`` mask (True on every
+    repeat after the first of a group).  Shared by the edge-harvest TC
+    kernels (models/tc.py) and ``pack_support_bits``."""
+    order_c = jnp.argsort(cols, stable=True)
+    r1, c1 = rows[order_c], cols[order_c]
+    order_r = jnp.argsort(r1, stable=True)
+    rows, cols = r1[order_r], c1[order_r]
+    dup = jnp.concatenate([
+        jnp.zeros((1,), bool),
+        (rows[1:] == rows[:-1]) & (cols[1:] == cols[:-1]),
+    ])
+    return rows, cols, dup
+
+
+def pack_support_bits(
+    rows: Array,
+    cols: Array,
+    nrows: int,
+    ncols: int,
+    *,
+    assume_unique: bool = False,
+) -> Array:
+    """COO support → packed [nrows, ceil(ncols/32)] uint32 bitmask.
+
+    Bit (i, j) is set iff some entry (i, j) exists with i < nrows and
+    j < ncols — sentinel/padded slots (row >= nrows) drop out via the
+    scatter's ``mode='drop'``.  Packing is a scatter-ADD of
+    ``2^(j mod 32)`` at (i, j div 32); duplicates would carry into the
+    next bit, so the input is ``coo_sort_dedup``-masked first unless the
+    caller guarantees uniqueness (e.g. compacted SpTuples).
+
+    This is the storage format of the output-support oracle: 32x less
+    memory and gather traffic than a bool matrix, and intersection
+    queries are ``popcount(a & b)`` (see ``popcount_pair_counts``).
+    """
+    nw = -(-ncols // 32)
+    if not assume_unique:
+        rows, cols, dup = coo_sort_dedup(rows, cols)
+        rows = jnp.where(dup, nrows, rows)
+    oob = (rows >= nrows) | (cols >= ncols)
+    r = jnp.where(oob, nrows, rows)
+    bits = jnp.zeros((nrows, nw), jnp.uint32)
+    return bits.at[r, cols >> 5].add(
+        jnp.uint32(1) << (cols.astype(jnp.uint32) & 31), mode="drop"
+    )
+
+
+def popcount_pair_counts(
+    bits_i: Array,
+    bits_j: Array,
+    ii: Array,
+    jj: Array,
+    weights: Array,
+    *,
+    chunk: int = 8192,
+) -> Array:
+    """Σ_pairs weights · popcount(bits_i[ii] ∩ bits_j[jj]) as an int32
+    (hi, lo) 15-bit split (totals can exceed 2^31; int64 is unavailable
+    without x64 mode — same rationale as models/tc.py).
+
+    The masked-SpGEMM numeric pass for 0/1-valued plus_times products:
+    each (i, j) pair's count is the exact C[i,j] = Σ_k A[i,k]·B[k,j]
+    restricted to the pair list (the output-support mask).  A lax.scan
+    walks static ``chunk``-sized pair blocks; per step two row gathers of
+    the packed tables + a streaming popcount — the bit-packed
+    edge-harvest inner loop (models/tc.py) generalized to two distinct
+    bit tables, which is what the DISTRIBUTED tier needs (row-block and
+    col-block masks live on different devices).
+
+    ``ii``/``jj``/``weights`` must be padded to a multiple of ``chunk``
+    with weight-0 slots (indices clamped in-range by the caller).
+    """
+    npairs = ii.shape[0]
+    assert npairs % chunk == 0, (npairs, chunk)
+
+    def body(carry, eidx):
+        hi, lo = carry
+        gi = bits_i[ii[eidx]]  # [chunk, nw] u32
+        gj = bits_j[jj[eidx]]
+        pc = lax.population_count(gi & gj)
+        cnt = jnp.sum(pc.astype(jnp.int32), axis=1) * weights[eidx]
+        # renormalize the split each step: an unbounded lo accumulation
+        # would itself wrap past 2^31 (models/tc.py rationale)
+        lo = lo + jnp.sum(cnt & 0x7FFF)
+        hi = hi + jnp.sum(cnt >> 15) + (lo >> 15)
+        lo = lo & 0x7FFF
+        return (hi, lo), None
+
+    idx = jnp.arange(npairs, dtype=jnp.int32).reshape(-1, chunk)
+    (hi, lo), _ = lax.scan(body, (jnp.int32(0), jnp.int32(0)), idx)
+    return jnp.stack([hi, lo])
+
+
+def combine_hilo(hilo) -> int:
+    """Exact host-side total from an int32 (hi, lo) 15-bit split."""
+    hilo = np.asarray(hilo, np.int64)
+    return int((hilo[0] << 15) + hilo[1])
+
+
+def spgemm_support_bits(
+    a: SpTuples,
+    b: SpTuples,
+    *,
+    row_block: int = 4096,
+) -> tuple[Array, Array]:
+    """Output-support oracle: the boolean pattern of a·b as a packed
+    [a.nrows, ceil(b.ncols/32)] uint32 bitmask, plus exact per-row
+    nonzero counts.
+
+    The pattern is computed as a row-blocked COUNTS product on the
+    matrix unit — bool(A) @ bool(B) in bf16 (0/1 inputs are exact; f32-
+    accumulated counts are exact below 2^24 ≈ any k <= 16M) — then
+    thresholded and bit-packed immediately, so only one [row_block,
+    ncols] dense block is ever live: the "cheap MXU work first" half of
+    the masked-SpGEMM design.  Callers run the numeric pass only over
+    the support (``popcount_pair_counts`` for 0/1 plus_times;
+    masked gather-dot for general values).
+
+    Only sensible where the dense operands fit (the MXU-tier envelope);
+    the windowed tier uses host symbolic sizing instead at larger
+    scales.
+    """
+    assert a.ncols == b.nrows
+    m, k, n = a.nrows, a.ncols, b.ncols
+    kpad = -(-k // 128) * 128
+    npad = -(-n // 128) * 128
+    nw = -(-n // 32)
+    da = densify(a.apply(lambda v: jnp.ones_like(v)), -(-m // row_block) * row_block, kpad, 0)
+    db = densify(b.apply(lambda v: jnp.ones_like(v)), kpad, npad, 0)
+    da = jnp.minimum(da, 1).astype(jnp.bfloat16)
+    db = jnp.minimum(db, 1).astype(jnp.bfloat16)
+    lanes = jnp.arange(32, dtype=jnp.uint32)
+    out_bits = []
+    out_cnt = []
+    nblocks = -(-m // row_block)
+    for blk in range(nblocks):
+        lo = blk * row_block
+        cnt = jnp.dot(
+            da[lo:lo + row_block], db, preferred_element_type=jnp.float32
+        )
+        live = cnt[:, :n] > 0
+        out_cnt.append(jnp.sum(live, axis=1).astype(jnp.int32))
+        lv = jnp.pad(live, ((0, 0), (0, nw * 32 - n)))
+        packed = jnp.sum(
+            lv.reshape(row_block, nw, 32).astype(jnp.uint32)
+            << lanes[None, None, :],
+            axis=-1, dtype=jnp.uint32,
+        )
+        out_bits.append(packed)
+    bits = jnp.concatenate(out_bits)[:m]
+    row_nnz = jnp.concatenate(out_cnt)[:m]
+    return bits, row_nnz
+
+
+def dense_support_nnz(dense: Array, zero, nrows: int, ncols: int) -> Array:
+    """Exact nonzero count of a (possibly padded) dense block — the
+    output-support size, used to size sparse extraction capacities
+    exactly instead of guess-and-retry (models/mcl.py dense path)."""
+    R, C = dense.shape
+    mask = dense != zero
+    if C != ncols:
+        mask = mask & (jnp.arange(C, dtype=jnp.int32)[None, :] < ncols)
+    if R != nrows:
+        mask = mask & (jnp.arange(R, dtype=jnp.int32)[:, None] < nrows)
+    return jnp.sum(mask).astype(jnp.int32)

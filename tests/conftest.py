@@ -28,7 +28,10 @@ import pytest
 def pytest_configure(config):
     # "slow" keeps stress/latency tests out of the tier-1 budget
     # (ROADMAP.md runs `-m 'not slow'`); registered here since the repo
-    # carries no pytest.ini.
+    # carries no pytest.ini.  Current slow set: the serve stress test
+    # (test_serve.py) and the end-to-end bench.py subprocess run
+    # (test_bench_summary.py) — the tier-1 guard for the summary-line
+    # contract is the FAST test in that same file.
     config.addinivalue_line(
         "markers", "slow: excluded from the tier-1 `-m 'not slow'` run"
     )

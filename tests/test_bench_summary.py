@@ -1,0 +1,146 @@
+"""Bench summary-line contract (ISSUE 3 satellites 1-2 + CI guard).
+
+The driver's end-of-round capture takes the LAST stdout line; the r05
+artifact ended up ``parsed: null`` because tail truncation of the giant
+per-run record ate the headline.  The contract under test: ``bench.py``'s
+final line is a COMPACT parseable JSON summary carrying ``value``,
+``median``, ``warning``, ``rc``, and the same object is mirrored to
+``BENCH_SUMMARY.json``.
+"""
+
+import importlib.util
+import io
+import json
+import os
+import subprocess
+import sys
+from contextlib import redirect_stdout
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: The summary line's required keys — the satellite-1 contract that the
+#: CI guard (this file) pins down.
+REQUIRED_KEYS = {"summary", "metric", "value", "median", "warning", "rc"}
+
+
+@pytest.fixture(scope="module")
+def benchmod():
+    spec = importlib.util.spec_from_file_location(
+        "benchmod_under_test", os.path.join(REPO, "bench.py")
+    )
+    m = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(m)
+    return m
+
+
+def test_emit_summary_is_parseable_with_required_keys(
+    benchmod, tmp_path, monkeypatch
+):
+    monkeypatch.setenv(
+        "BENCH_SUMMARY_PATH", str(tmp_path / "BENCH_SUMMARY.json")
+    )
+    official = {
+        "metric": "graph500_bfs_rmat_scale20_1chip_MTEPS",
+        "value": 14.5,
+        "batch_median_mteps": 246.4,
+        "warning": None,
+        "runs": [{"huge": "x" * 10000}],  # the giant record is NOT copied
+    }
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        benchmod.emit_summary(official)
+    lines = buf.getvalue().strip().splitlines()
+    s = json.loads(lines[-1])  # the FINAL line parses alone
+    assert REQUIRED_KEYS <= set(s)
+    assert s["value"] == 14.5
+    assert s["median"] == 246.4
+    assert s["rc"] == 0
+    assert len(lines[-1]) < 400, "summary must be truncation-proof small"
+    sidecar = json.loads((tmp_path / "BENCH_SUMMARY.json").read_text())
+    assert sidecar == s
+
+
+def test_emit_summary_survives_unwritable_sidecar(benchmod, monkeypatch):
+    monkeypatch.setenv(
+        "BENCH_SUMMARY_PATH", "/nonexistent-dir/BENCH_SUMMARY.json"
+    )
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        benchmod.emit_summary({"value": 1.0}, rc=1)
+    s = json.loads(buf.getvalue().strip().splitlines()[-1])
+    assert s["rc"] == 1 and "summary_write_error" in s
+
+
+def test_variance_block_names_the_suspect(benchmod):
+    runs = [{"mteps": 40.0, "warmup_s": 5.0}] * 3
+    v = benchmod.diagnose_variance(runs, {"mteps": 280.0})
+    assert v["suspect"] == "warmup_contamination"
+    v = benchmod.diagnose_variance(
+        [{"mteps": 40.0, "warmup_s": 120.0}], {"mteps": 50.0}
+    )
+    assert v["suspect"] == "cache_cold"
+    v = benchmod.diagnose_variance(runs, {"mteps": 50.0})
+    assert v["suspect"] == "degraded_regime"
+    assert {"median_mteps", "operating_point_mteps", "rerun_mteps",
+            "detail"} <= set(v)
+
+
+def test_emit_reports_median_and_spread(benchmod, capsys):
+    runs = [
+        {"mteps": 90.0}, {"mteps": 100.0}, {"mteps": 130.0},
+    ]
+    out = benchmod.emit(runs, [], 1.0, {}, 0.0)
+    capsys.readouterr()
+    assert out["batch_median_mteps"] == 100.0
+    sp = out["repeats_spread"]
+    assert sp["min"] == 90.0 and sp["max"] == 130.0
+    assert sp["rel_spread"] == pytest.approx(0.4)
+    # a variance block rides the official record when provided
+    out = benchmod.emit(
+        runs, [], 1.0, {}, 0.0, {"suspect": "degraded_regime"}
+    )
+    capsys.readouterr()
+    assert out["variance"]["suspect"] == "degraded_regime"
+
+
+def test_spgemm_bench_summary_fields():
+    """The SpGEMM bench line also satisfies the driver's minimal
+    contract (parseable, has "value") — pinned here since the perf
+    acceptance reads it."""
+    # static check on the emitted dict keys (no run): the bench builds
+    # its JSON inline, so just assert the file mentions the fields the
+    # driver parses
+    src = open(os.path.join(REPO, "benchmarks", "spgemm_bench.py")).read()
+    for field in ('"value"', '"out_nnz"', '"overflow"', '"tier"'):
+        assert field in src, field
+
+
+@pytest.mark.slow
+def test_bench_end_to_end_summary_line(tmp_path):
+    """Full bench.py subprocess at a toy scale: stdout ends with the
+    parseable summary line and BENCH_SUMMARY.json is written."""
+    env = dict(os.environ)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        BENCH_SCALE="8", BENCH_NROOTS="8", BENCH_REPEATS="1",
+        BENCH_SEQ_ROOTS="0", BENCH_VALIDATE="0", BENCH_DRAIN_S="0",
+        BENCH_BUDGET_S="600",
+        BENCH_SUMMARY_PATH=str(tmp_path / "BENCH_SUMMARY.json"),
+    )
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=900,
+    )
+    lines = [l for l in r.stdout.strip().splitlines() if l.strip()]
+    assert lines, r.stderr[-2000:]
+    s = json.loads(lines[-1])
+    assert REQUIRED_KEYS <= set(s), s
+    assert s["rc"] == 0, (s, r.stderr[-2000:])
+    assert s["value"] > 0
+    # the full record is on an EARLIER line
+    full = json.loads(lines[-2])
+    assert "runs" in full and full["value"] == s["value"]
+    sidecar = json.loads((tmp_path / "BENCH_SUMMARY.json").read_text())
+    assert sidecar == s

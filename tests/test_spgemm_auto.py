@@ -1,0 +1,355 @@
+"""Auto-tiered SpGEMM: router, windowed kernel, support oracle, and the
+distributed edge-harvest TC tier (ISSUE 3 tentpole).
+
+Property contract: every tier is EXACT — ``spgemm_auto`` must agree with
+the ESC golden across semirings, duplicate-entry COO inputs, empty-output
+blocks, and forced-tier overrides (the MultTest golden-product pattern,
+ReleaseTests/MultTest.cpp:122-234).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from combblas_tpu import MAX_MIN, MIN_PLUS, PLUS_TIMES, obs
+from combblas_tpu.ops.compressed import CSR, CSC
+from combblas_tpu.ops.spgemm import (
+    combine_hilo,
+    dense_support_nnz,
+    pack_support_bits,
+    popcount_pair_counts,
+    scatter_combine_for,
+    spgemm_support_bits,
+)
+from combblas_tpu.ops.tuples import SpTuples
+from combblas_tpu.parallel.grid import Grid
+from combblas_tpu.parallel.spgemm import (
+    choose_tier_from_counts,
+    default_block_rows,
+    spgemm,
+    spgemm_auto,
+    spgemm_windowed,
+    summa_rowblock_flops,
+    summa_rowblock_flops_host,
+    summa_spgemm_windowed,
+    windowed_plan,
+)
+from combblas_tpu.parallel.spmat import SpParMat
+from combblas_tpu.semiring import Semiring
+
+
+def coo(rng, m, k, nnz, dup_frac=0.0):
+    r = rng.integers(0, m, nnz).astype(np.int64)
+    c = rng.integers(0, k, nnz).astype(np.int64)
+    v = (rng.random(nnz) + 0.5).astype(np.float32)
+    ndup = int(nnz * dup_frac)
+    if ndup:
+        r = np.concatenate([r, r[:ndup]])
+        c = np.concatenate([c, c[:ndup]])
+        v = np.concatenate([v, (rng.random(ndup) + 0.5).astype(np.float32)])
+    return r, c, v
+
+
+def dense_of(M: SpParMat) -> np.ndarray:
+    """Host reconstruction; duplicate slots ADD (plus_times semantics) —
+    only call on compacted products or plus_times inputs."""
+    r, c, v, _ = jax.device_get((M.rows, M.cols, M.vals, M.nnz))
+    out = np.zeros((M.nrows, M.ncols), np.float64)
+    lr, lc = M.local_rows, M.local_cols
+    for i in range(M.grid.pr):
+        for j in range(M.grid.pc):
+            m_ = r[i, j] < lr
+            np.add.at(
+                out,
+                (r[i, j][m_] + i * lr, c[i, j][m_] + j * lc),
+                v[i, j][m_],
+            )
+    return out
+
+
+def host_nnz(M: SpParMat) -> int:
+    return int(np.asarray(jax.device_get(M.getnnz())))
+
+
+@pytest.mark.parametrize("srname", ["plus_times", "min_plus", "max_min"])
+@pytest.mark.parametrize("p", [1, 2])
+def test_windowed_matches_esc_across_semirings(rng, srname, p):
+    """spgemm_auto(tier='windowed') == ESC, duplicate-entry COO input."""
+    sr = {"plus_times": PLUS_TIMES, "min_plus": MIN_PLUS,
+          "max_min": MAX_MIN}[srname]
+    grid = Grid.make(p, p)
+    m, k, n = 64, 48, 80
+    ra, ca, va = coo(rng, m, k, 500, dup_frac=0.2)
+    rb, cb, vb = coo(rng, k, n, 600, dup_frac=0.2)
+    A = SpParMat.from_global_coo(grid, ra, ca, va, m, k)
+    B = SpParMat.from_global_coo(grid, rb, cb, vb, k, n)
+    C_esc = spgemm(sr, A, B)
+    C_win = spgemm_auto(sr, A, B, tier="windowed", block_rows=16)
+    # both outputs are compacted/unique per cell: dense compare is exact
+    np.testing.assert_allclose(
+        dense_of(C_win), dense_of(C_esc), rtol=1e-5, atol=1e-6
+    )
+    assert host_nnz(C_win) == host_nnz(C_esc)
+
+
+def test_windowed_exact_for_integer_counts(rng):
+    """0/1 adjacency A²: counts are integers — bit-exact vs ESC."""
+    grid = Grid.make(2, 2)
+    m = 96
+    ra, ca, _ = coo(rng, m, m, 900, dup_frac=0.1)
+    ones = np.ones(len(ra), np.float32)
+    A = SpParMat.from_global_coo(grid, ra, ca, ones, m, m)
+    # ESC golden needs the DEDUPED input for 0/1 semantics
+    key = np.unique(ra * m + ca)
+    Au = SpParMat.from_global_coo(
+        grid, key // m, key % m, np.ones(len(key), np.float32), m, m
+    )
+    C_esc = spgemm(PLUS_TIMES, Au, Au)
+    C_win = spgemm_windowed(PLUS_TIMES, Au, Au, block_rows=16)
+    np.testing.assert_array_equal(dense_of(C_win), dense_of(C_esc))
+    assert host_nnz(C_win) == host_nnz(C_esc)
+
+
+def test_empty_output_blocks_are_skipped(rng):
+    """Rows with no A entries produce empty output blocks — the symbolic
+    plan must mark them skipped, and the result still matches ESC."""
+    grid = Grid.make(1, 1)
+    m = 64
+    # A entries confined to rows [0, 8): blocks 1..7 of 8 are empty
+    ra = rng.integers(0, 8, 120).astype(np.int64)
+    ca = rng.integers(0, m, 120).astype(np.int64)
+    va = np.ones(120, np.float32)
+    A = SpParMat.from_global_coo(grid, ra, ca, va, m, m)
+    rb, cb, vb = coo(rng, m, m, 400)
+    B = SpParMat.from_global_coo(grid, rb, cb, vb, m, m)
+    pb = np.asarray(
+        jax.device_get(summa_rowblock_flops(A, B, 8, chunk_w=8))
+    )
+    pt = np.asarray(jax.device_get(summa_rowblock_flops(A, B, 8)))
+    fc, oc, skip = windowed_plan(pb, pt, 8, A.local_rows, B.local_cols)
+    assert skip[0] is False and all(skip[1:]), skip
+    C_win, overflow = summa_spgemm_windowed(
+        PLUS_TIMES, A, B, block_rows=8, flop_caps=fc, out_caps=oc,
+        skip=skip, backend="scatter",
+    )
+    assert int(overflow) <= 0
+    C_esc = spgemm(PLUS_TIMES, A, B)
+    np.testing.assert_allclose(
+        dense_of(C_win), dense_of(C_esc), rtol=1e-5, atol=1e-6
+    )
+
+
+def test_forced_tier_overrides_agree(rng, monkeypatch):
+    grid = Grid.make(2, 2)
+    m = 48
+    ra, ca, va = coo(rng, m, m, 300)
+    # UNIQUE entries: the mxu tier densifies with the unique_indices
+    # scatter contract (duplicate tolerance belongs to the esc/scan/
+    # windowed tiers, covered above)
+    key, idx = np.unique(ra * m + ca, return_index=True)
+    ra, ca, va = ra[idx], ca[idx], va[idx]
+    A = SpParMat.from_global_coo(grid, ra, ca, va, m, m)
+    ref = dense_of(spgemm(PLUS_TIMES, A, A))
+    for tier in ("esc", "scan", "windowed", "mxu"):
+        C = spgemm_auto(PLUS_TIMES, A, A, tier=tier, interpret=True)
+        np.testing.assert_allclose(
+            dense_of(C), ref, rtol=1e-4, atol=1e-5
+        )
+    # env override is honored
+    monkeypatch.setenv("COMBBLAS_SPGEMM_TIER", "windowed")
+    C = spgemm_auto(PLUS_TIMES, A, A)
+    np.testing.assert_allclose(dense_of(C), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_tier_gate_rules():
+    """The routing rule: mxu for small dense-kernel tiles; windowed only
+    with a scatter combiner, bounded cells, and dense-enough output;
+    scan otherwise."""
+    generic = Semiring(
+        name="generic_test", add=jnp.add, mul=jnp.multiply,
+        zero_fn=lambda dt: 0, add_kind="generic",
+    )
+    assert scatter_combine_for(generic) is None
+    # small tile + dense-kernel semiring → mxu
+    assert choose_tier_from_counts(
+        PLUS_TIMES, 4096, 4096 * 4096, 1, 1e6, "scatter"
+    ) == "mxu"
+    # big tile, dense output, scatter combiner → windowed
+    assert choose_tier_from_counts(
+        PLUS_TIMES, 1 << 16, 1 << 32, 1, 1e9, "scatter"
+    ) == "windowed"
+    # generic monoid cannot scatter → scan
+    assert choose_tier_from_counts(
+        generic, 1 << 16, 1 << 32, 1, 1e9, "scatter"
+    ) == "scan"
+    # output too sparse relative to the dense tile → scan
+    assert choose_tier_from_counts(
+        PLUS_TIMES, 1 << 20, 1 << 33, 1, 1e3, "scatter"
+    ) == "scan"
+    # dot backend has no windowed formulation (MXU path handles it)
+    assert choose_tier_from_counts(
+        PLUS_TIMES, 1 << 16, 1 << 32, 1, 1e9, "dot"
+    ) == "scan"
+
+
+def test_router_records_obs_counters(rng):
+    grid = Grid.make(1, 1)
+    m = 48
+    ra, ca, va = coo(rng, m, m, 300)
+    A = SpParMat.from_global_coo(grid, ra, ca, va, m, m)
+    obs.enable(install_hooks=False)
+    try:
+        obs.reset()
+        spgemm_auto(PLUS_TIMES, A, A, tier="windowed", block_rows=16)
+        assert obs.registry.get_counter(
+            "spgemm.auto.tier", tier="windowed", sr="plus_times"
+        ) == 1
+        assert obs.registry.get_gauge("spgemm.windowed.blocks") == 3
+        assert obs.registry.get_counter(
+            "spgemm.windowed.windows_skipped"
+        ) >= 0
+        assert obs.registry.get_gauge("spgemm.auto.mask_density") > 0
+    finally:
+        obs.disable()
+        obs.reset()
+
+
+def test_rowblock_flops_host_matches_device(rng):
+    grid = Grid.make(2, 2)
+    m, k, n = 64, 48, 80
+    ra, ca, va = coo(rng, m, k, 400)
+    rb, cb, vb = coo(rng, k, n, 500)
+    A = SpParMat.from_global_coo(grid, ra, ca, va, m, k)
+    B = SpParMat.from_global_coo(grid, rb, cb, vb, k, n)
+    for w in (0, 8):
+        dev = np.asarray(
+            jax.device_get(summa_rowblock_flops(A, B, 8, chunk_w=w))
+        )
+        host = summa_rowblock_flops_host(
+            grid, ra, ca, rb, cb, m, k, n, 8, chunk_w=w
+        )
+        np.testing.assert_array_equal(dev.astype(np.int64),
+                                      host.astype(np.int64))
+
+
+def test_support_oracle_exact(rng):
+    da = (rng.random((50, 40)) < 0.2).astype(np.float32)
+    db = (rng.random((40, 60)) < 0.2).astype(np.float32)
+    a = SpTuples.from_dense(da, capacity=600)
+    b = SpTuples.from_dense(db, capacity=600)
+    bits, row_nnz = spgemm_support_bits(a, b, row_block=16)
+    P = (da @ db) > 0
+    got = np.zeros_like(P)
+    bb = np.asarray(bits)
+    for j in range(60):
+        got[:, j] = (bb[:, j >> 5] >> (j & 31)) & 1
+    np.testing.assert_array_equal(got, P)
+    np.testing.assert_array_equal(np.asarray(row_nnz), P.sum(1))
+    # masked numeric pass over the support: popcount counts == A·B values
+    ii, jj = np.nonzero(P)
+    chunk = 64
+    pad = -(-len(ii) // chunk) * chunk
+    iiP = np.pad(ii, (0, pad - len(ii))).astype(np.int32)
+    jjP = np.pad(jj, (0, pad - len(jj))).astype(np.int32)
+    w = np.pad(np.ones(len(ii), np.int32), (0, pad - len(ii)))
+    abits = pack_support_bits(a.rows, a.cols, 50, 40)
+    btbits = CSC.from_tuples(b).to_bitmask()
+    hilo = popcount_pair_counts(
+        abits, btbits, jnp.asarray(iiP), jnp.asarray(jjP),
+        jnp.asarray(w), chunk=chunk,
+    )
+    assert combine_hilo(hilo) == int((da @ db)[ii, jj].sum())
+
+
+def test_pack_support_bits_dedups(rng):
+    m, n = 37, 70
+    r = rng.integers(0, m, 200).astype(np.int32)
+    c = rng.integers(0, n, 200).astype(np.int32)
+    r = np.concatenate([r, r[:50]])
+    c = np.concatenate([c, c[:50]])  # hard duplicates: would carry bits
+    bits = pack_support_bits(jnp.asarray(r), jnp.asarray(c), m, n)
+    ref = np.zeros((m, n), bool)
+    ref[r, c] = True
+    bb = np.asarray(bits)
+    got = np.zeros((m, n), bool)
+    for j in range(n):
+        got[:, j] = (bb[:, j >> 5] >> (j & 31)) & 1
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_csr_csc_bitmask_views(rng):
+    d = (rng.random((20, 45)) < 0.25).astype(np.float32)
+    t = SpTuples.from_dense(d, capacity=300)
+    rb = np.asarray(CSR.from_tuples(t).to_bitmask())
+    cb = np.asarray(CSC.from_tuples(t).to_bitmask())
+    for i in range(20):
+        for j in range(45):
+            assert bool((rb[i, j >> 5] >> (j & 31)) & 1) == bool(d[i, j])
+            assert bool((cb[j, i >> 5] >> (i & 31)) & 1) == bool(d[i, j])
+
+
+def test_dense_support_nnz_padding(rng):
+    d = (rng.random((32, 48)) < 0.3).astype(np.float32)
+    assert int(dense_support_nnz(jnp.asarray(d), 0.0, 30, 40)) == int(
+        (d[:30, :40] != 0).sum()
+    )
+
+
+def test_distributed_edge_harvest_tc_matches_masked(rng):
+    """ISSUE 3 satellite: distributed bit-packed edge-harvest TC vs the
+    masked-SpGEMM count (the sparse path), duplicate entries included."""
+    from combblas_tpu.models.tc import triangle_count
+
+    # n chosen so local_cols (n/2 on the 2x2 grid) is a multiple of 32 —
+    # the distributed tier's word-aligned tile-concat requirement
+    n = 128
+    m = rng.random((n, n)) < 0.08
+    m = np.triu(m, 1)
+    m = m | m.T
+    r0, c0 = np.nonzero(m)
+    dup = rng.choice(len(r0), 30)
+    r = np.concatenate([r0, r0[dup]])
+    c = np.concatenate([c0, c0[dup]])
+    grid = Grid.make(2, 2)
+    A = SpParMat.from_global_coo(
+        grid, r, c, np.ones(len(r), np.float32), n, n
+    )
+    Au = SpParMat.from_global_coo(
+        grid, r0, c0, np.ones(len(r0), np.float32), n, n
+    )
+    want = triangle_count(Au, kernel="sparse")  # masked-SpGEMM count
+    assert triangle_count(A, kernel="edgeharvest") == want
+    assert triangle_count(A) == want  # auto routes to the tier
+    ref = int(np.trace(np.linalg.matrix_power(m.astype(np.int64), 3)) // 6)
+    assert want == ref
+
+
+def test_distributed_edge_harvest_tc_ceil_blocked(rng):
+    """n % local_rows != 0 (ceil-blocking over-cover): the n-sentinel
+    minus the last block's offset lands INSIDE the local range — the
+    kernel must drop padded/dup/loop slots explicitly, not by sentinel
+    arithmetic (regression: corrupted bitmask via scatter-add carry)."""
+    from combblas_tpu.models.tc import triangle_count
+
+    n = 127  # 2x2 grid → lr = lc = 64 (word-aligned), p*lr = 128 > n
+    m = rng.random((n, n)) < 0.1
+    m = np.triu(m, 1)
+    m = m | m.T
+    r0, c0 = np.nonzero(m)
+    # duplicates AND a self-loop stored on the last grid row
+    r = np.concatenate([r0, r0[:20], [n - 1]])
+    c = np.concatenate([c0, c0[:20], [n - 1]])
+    grid = Grid.make(2, 2)
+    A = SpParMat.from_global_coo(
+        grid, r, c, np.ones(len(r), np.float32), n, n
+    )
+    ref = int(np.trace(np.linalg.matrix_power(m.astype(np.int64), 3)) // 6)
+    assert triangle_count(A, kernel="edgeharvest") == ref
+
+
+def test_default_block_rows_bounds():
+    br = default_block_rows(1 << 16, 1 << 16)
+    assert 1 <= br <= 1 << 16
+    assert -(-(1 << 16) // br) <= 33  # ~WINDOWED_MAX_BLOCKS programs
+    assert default_block_rows(5, 7) >= 5  # tiny tiles: one block
