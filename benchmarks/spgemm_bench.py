@@ -29,6 +29,16 @@ OCAP = os.environ.get("BENCH_OCAP")  # override out_capacity (mxu sparsify
 # agreement with ESC. =0 skips (saves the host product + readback).
 GOLDEN = os.environ.get("BENCH_GOLDEN", "1") == "1"
 BLOCK_ROWS = int(os.environ.get("BENCH_BLOCK_ROWS", "0"))  # windowed tier
+BLOCK_COLS = int(os.environ.get("BENCH_BLOCK_COLS", "0"))  # 2D dot backend
+# R-MAT edge factor: flops (and the sort-based tiers' cost) grow with
+# it while dense n^3 work is fixed, so sweeping it traces the
+# scan -> windowed-dot crossover at one scale (results/r7).
+EDGEFACTOR = int(os.environ.get("BENCH_EDGEFACTOR", "8"))
+# windowed-dot stage-product precision (parallel/spgemm._mxu_dot):
+# f32 | bf16 | bf16x3.  f32 default — exact everywhere; on the chip
+# bf16 is the fast mode (exact for 0/1 counts < 2^24).
+DOT_MODE = os.environ.get("BENCH_DOT_MODE", "f32")
+_EFTAG = f"ef{EDGEFACTOR}" if EDGEFACTOR != 8 else ""
 
 
 def main():
@@ -52,7 +62,7 @@ def main():
 
     grid = Grid.make(1, 1)
     n = 1 << SCALE
-    rows, cols = rmat_symmetric_coo_host(5, SCALE, 8)
+    rows, cols = rmat_symmetric_coo_host(5, SCALE, EDGEFACTOR)
     key = rows * np.int64(n) + cols
     uniq = np.unique(key)
     ru, cu = uniq // n, uniq % n
@@ -75,13 +85,21 @@ def main():
     # name keeps the requested "auto" and the JSON carries the tier.
     kernel = KERNEL
     tier = None
+    backend = None
+    if KERNEL in ("auto", "windowed"):
+        from combblas_tpu.parallel.spgemm import resolve_spgemm_backend
+
+        # COMBBLAS_SPGEMM_BACKEND=dot forces the 2D MXU path (the TPU
+        # stand-in run on this CPU image); default follows the platform
+        backend = resolve_spgemm_backend()
     if KERNEL == "auto":
         from combblas_tpu.parallel.spgemm import choose_tier_from_counts
 
         lrA_, lcB_ = grid.local_rows(n), grid.local_cols(n)
         tier = choose_tier_from_counts(
             PLUS_TIMES, max(lrA_, lcB_), lrA_ * lcB_, grid.pr,
-            float(flops), backend="scatter",
+            float(flops), backend, k_dim=grid.local_rows(n),
+            n_dim=lcB_,
         )
         obs.count("spgemm.auto.tier", tier=tier, sr="plus_times")
         kernel = tier
@@ -119,11 +137,17 @@ def main():
         # chosen tier through obs.
         from combblas_tpu.parallel.spgemm import (
             WINDOWED_CHUNK_W,
+            _pad128,
+            default_block_cols,
             default_block_rows,
             local_spgemm_windowed,
+            panel_cap_from_bnnz,
             summa_rowblock_flops_host,
             summa_spgemm_windowed,
+            summa_window_bnnz_host,
+            summa_window_flops_host,
             windowed_plan,
+            windowed_plan_2d,
         )
 
         lrA = grid.local_rows(n)
@@ -132,40 +156,100 @@ def main():
         # a direct BENCH_KERNEL=windowed request is its own tier
         tier = tier or "windowed"
         block_rows = BLOCK_ROWS or default_block_rows(lrA, lcB)
-        pb = summa_rowblock_flops_host(
-            grid, ru, cu, ru, cu, n, n, n, block_rows,
-            chunk_w=WINDOWED_CHUNK_W,
-        )
-        pt = summa_rowblock_flops_host(
-            grid, ru, cu, ru, cu, n, n, n, block_rows, chunk_w=0
-        )
-        flop_caps, out_caps, skip = windowed_plan(
-            pb, pt, block_rows, lrA, lcB
-        )
-        obs.count("spgemm.windowed.windows_skipped", sum(skip))
-        obs.gauge("spgemm.windowed.blocks", len(skip))
-        # same quantity as the library emitter (parallel/spgemm.py:
-        # spgemm_windowed): raw symbolic output bound over dense cells
-        obs.gauge(
-            "spgemm.auto.mask_density",
-            float(np.asarray(pt).sum(axis=1).max(axis=(-1, -2)).sum())
-            / max(lrA * lcB, 1),
-        )
+        extra = {}
+        if backend == "dot":
+            # 2D B-column-windowed MXU form, sized host-only (axon D2H
+            # rule): the 2D symbolic pass, the plan, and the panel slice
+            # capacity all come from the COO before any upload.
+            block_cols = BLOCK_COLS or default_block_cols(
+                grid.local_rows(n), lcB
+            )
+            # one TRUE-counts pass only: the dot backend never consumes
+            # flop caps (no chunked expansion), so the chunk_w-padded
+            # einsum would be dead sizing work
+            pt = summa_window_flops_host(
+                grid, ru, cu, ru, cu, n, n, n, block_rows, block_cols,
+                chunk_w=0,
+            )
+            flop_caps, out_caps, skip = windowed_plan_2d(
+                None, pt, block_rows, block_cols, lrA, lcB
+            )
+            panel_cap = panel_cap_from_bnnz(
+                summa_window_bnnz_host(grid, ru, cu, n, n, block_cols),
+                len(ru),
+            )
+            nskip = sum(sum(row) for row in skip)
+            obs.count("spgemm.windowed.col_windows_skipped", nskip)
+            obs.gauge(
+                "spgemm.windowed.col_windows", len(skip[0]) if skip else 0
+            )
+            obs.gauge(
+                "spgemm.windowed.panel_cells",
+                _pad128(grid.local_rows(n)) * _pad128(block_cols),
+            )
+            obs.gauge("spgemm.windowed.blocks", len(skip))
+            extra = {
+                "backend": "dot",
+                "mode": DOT_MODE,
+                "block_cols": block_cols,
+                "col_windows": len(skip[0]) if skip else 0,
+                "col_windows_skipped": int(nskip),
+                "panel_cap": int(panel_cap),
+                "panel_cells": int(
+                    _pad128(grid.local_rows(n)) * _pad128(block_cols)
+                ),
+            }
 
-        def mult(a):
-            # grid 1x1 here: the per-block-program fast path (the fused
-            # shard_map graph measures >2x slower on XLA:CPU)
-            if grid.size == 1:
-                return local_spgemm_windowed(
+            def mult(a):
+                if grid.size == 1:
+                    return local_spgemm_windowed(
+                        PLUS_TIMES, a, a, block_rows=block_rows,
+                        flop_caps=flop_caps, out_caps=out_caps,
+                        skip=skip, backend="dot", block_cols=block_cols,
+                        panel_cap=panel_cap, mode=DOT_MODE,
+                    )
+                return summa_spgemm_windowed(
                     PLUS_TIMES, a, a, block_rows=block_rows,
                     flop_caps=flop_caps, out_caps=out_caps, skip=skip,
-                    chunk_w=WINDOWED_CHUNK_W,
+                    backend="dot", mode=DOT_MODE,
+                    chunk_w=WINDOWED_CHUNK_W, block_cols=block_cols,
+                    panel_cap=panel_cap,
                 )
-            return summa_spgemm_windowed(
-                PLUS_TIMES, a, a, block_rows=block_rows,
-                flop_caps=flop_caps, out_caps=out_caps, skip=skip,
-                backend="scatter", chunk_w=WINDOWED_CHUNK_W,
+        else:
+            pb = summa_rowblock_flops_host(
+                grid, ru, cu, ru, cu, n, n, n, block_rows,
+                chunk_w=WINDOWED_CHUNK_W,
             )
+            pt = summa_rowblock_flops_host(
+                grid, ru, cu, ru, cu, n, n, n, block_rows, chunk_w=0
+            )
+            flop_caps, out_caps, skip = windowed_plan(
+                pb, pt, block_rows, lrA, lcB
+            )
+            obs.count("spgemm.windowed.windows_skipped", sum(skip))
+            obs.gauge("spgemm.windowed.blocks", len(skip))
+            # same quantity as the library emitter (parallel/spgemm.py:
+            # spgemm_windowed): raw symbolic output bound over dense cells
+            obs.gauge(
+                "spgemm.auto.mask_density",
+                float(np.asarray(pt).sum(axis=1).max(axis=(-1, -2)).sum())
+                / max(lrA * lcB, 1),
+            )
+
+            def mult(a):
+                # grid 1x1 here: the per-block-program fast path (the
+                # fused shard_map graph measures >2x slower on XLA:CPU)
+                if grid.size == 1:
+                    return local_spgemm_windowed(
+                        PLUS_TIMES, a, a, block_rows=block_rows,
+                        flop_caps=flop_caps, out_caps=out_caps, skip=skip,
+                        chunk_w=WINDOWED_CHUNK_W,
+                    )
+                return summa_spgemm_windowed(
+                    PLUS_TIMES, a, a, block_rows=block_rows,
+                    flop_caps=flop_caps, out_caps=out_caps, skip=skip,
+                    backend="scatter", chunk_w=WINDOWED_CHUNK_W,
+                )
 
         C, ov = mult(A)  # warmup/compile
         jax.block_until_ready(C.vals)
@@ -176,7 +260,10 @@ def main():
         nnz_v = int(jax.device_get(C.getnnz()))  # barrier
         dt = time.perf_counter() - t0
         out = {
-            "metric": f"spgemm_AxA_rmat_scale{SCALE}_{KERNEL}_MFLOPs",
+            "metric": (
+                f"spgemm_AxA_rmat_scale{SCALE}{_EFTAG}_{KERNEL}"
+                f"{'dot' if backend == 'dot' else ''}_MFLOPs"
+            ),
             "value": round(flops * 2 * REPS / dt / 1e6, 2),
             "unit": "MFLOP/s",
             "flops": int(flops),
@@ -186,7 +273,11 @@ def main():
             "tier": tier,
             "block_rows": block_rows,
             "blocks": len(skip),
-            "windows_skipped": int(sum(skip)),
+            "windows_skipped": (
+                int(sum(skip)) if backend != "dot"
+                else extra["col_windows_skipped"]
+            ),
+            **extra,
         }
         if GOLDEN:
             # EXACT agreement with the A² golden: 0/1 adjacency counts
@@ -299,7 +390,7 @@ def main():
         print(
             json.dumps(
                 {
-                    "metric": f"spgemm_AxA_rmat_scale{SCALE}_scanphased{PHASES}_MFLOPs",
+                    "metric": f"spgemm_AxA_rmat_scale{SCALE}{_EFTAG}_scanphased{PHASES}_MFLOPs",
                     "value": round(flops * 2 * REPS / dt / 1e6, 2),
                     "unit": "MFLOP/s",
                     "flops": int(flops),
@@ -390,7 +481,7 @@ def main():
         dt = time.perf_counter() - t0
         C = mult(A)
     out = {
-        "metric": f"spgemm_AxA_rmat_scale{SCALE}_{KERNEL}_MFLOPs",
+        "metric": f"spgemm_AxA_rmat_scale{SCALE}{_EFTAG}_{KERNEL}_MFLOPs",
         "value": round(flops * 2 * REPS / dt / 1e6, 2),
         "unit": "MFLOP/s",
         "flops": int(flops),

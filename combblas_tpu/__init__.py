@@ -45,9 +45,13 @@ from .parallel.spgemm import (
     block_spgemm,
     calculate_phases,
     choose_spgemm_tier,
+    coo_has_duplicates,
+    default_block_cols,
+    default_block_rows,
     estimate_flops,
     estimate_nnz_upper,
     mem_efficient_spgemm,
+    resolve_spgemm_backend,
     spgemm,
     spgemm_auto,
     spgemm_scan,
@@ -82,7 +86,8 @@ __all__ = [
     "DistVec",
     # distributed algebra
     "spgemm", "spgemm_scan", "spgemm_auto", "spgemm_windowed",
-    "choose_spgemm_tier", "mem_efficient_spgemm",
+    "choose_spgemm_tier", "coo_has_duplicates", "resolve_spgemm_backend",
+    "default_block_rows", "default_block_cols", "mem_efficient_spgemm",
     "block_spgemm", "spgemm3d", "summa_spgemm_mxu",
     "summa_spgemm_windowed", "PhaseAdjustedWarning",
     "estimate_flops", "estimate_nnz_upper", "calculate_phases",

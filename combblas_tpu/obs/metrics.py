@@ -30,7 +30,44 @@ name                                kind     meaning
                                              density estimate)
 ``trace.summa_spgemm_windowed``     counter  kernel (re)traces, labeled by
                                              accumulate ``backend``
+                                             (``scatter``/``dot``/``dot2d``)
 ==================================  =======  ==============================
+
+2D windowed ``dot`` backend series (round 7 — the B-column-windowed MXU
+tier that makes ``windowed`` the TPU mid-scale default, docs/spgemm.md):
+
+=========================================  =======  =====================
+name                                       kind     meaning
+=========================================  =======  =====================
+``spgemm.windowed.col_windows_skipped``    counter  (row block, col
+                                                    window) pairs proved
+                                                    symbolically empty —
+                                                    never densified,
+                                                    matmul'd, or scanned
+``spgemm.windowed.col_windows``            gauge    col windows per row
+                                                    block in the last 2D
+                                                    plan
+``spgemm.windowed.panel_cells``            gauge    padded-k × padded-
+                                                    window cells of one
+                                                    dense B stage panel
+                                                    (the stage-operand
+                                                    memory envelope; ≤
+                                                    WINDOWED_MAX_PANEL_
+                                                    CELLS when routed)
+``spgemm.windowed.window_density``         gauge    symbolic output bound
+                                                    over dense cells,
+                                                    restricted to LIVE
+                                                    (non-skipped) windows
+``spgemm.auto.dedup_fallback``             counter  mxu routings demoted
+                                                    because a tile held
+                                                    duplicate entries
+                                                    (labels: ``sr``)
+``spgemm.windowed.oracle_skipped``         counter  oracle=True requests
+                                                    that fell back to
+                                                    clamped-flops caps
+                                                    (outside the oracle
+                                                    envelope)
+=========================================  =======  =====================
 """
 
 from __future__ import annotations

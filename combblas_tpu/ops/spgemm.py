@@ -452,6 +452,67 @@ def mask_rows(t: SpTuples, row_lo: int, row_hi: int) -> SpTuples:
     )
 
 
+def densify_combine(
+    sr: Semiring, t: SpTuples, pad_rows: int, pad_cols: int
+) -> Array:
+    """Tile tuples → dense [pad_rows, pad_cols], duplicate slots COMBINED
+    with the semiring's add monoid (``at[].{add,min,max}``).
+
+    The duplicate-safe twin of ``densify``: that one claims
+    ``unique_indices`` (undefined result on repeated (row, col) slots —
+    the mxu tier's documented precondition), this one folds repeats with
+    the same combiner the scatter backend uses, so every densifying
+    consumer of it absorbs duplicate-entry COO inputs exactly.  No sort
+    is needed (unsorted scatters combine associatively), which also makes
+    it the cheaper choice for per-stage/per-window panel builds.  Only
+    defined for semirings with a native scatter combiner
+    (``scatter_combine_for``); cells with no entries hold ``sr.zero``.
+    """
+    comb = scatter_combine_for(sr)
+    assert comb is not None, (
+        f"semiring {sr.name} (add_kind={sr.add_kind}) has no scatter "
+        "combiner; use densify on pre-compacted tiles instead"
+    )
+    zero = sr.zero(t.vals.dtype)
+    ok = t.valid_mask() & (t.rows < pad_rows) & (t.cols < pad_cols)
+    flat = jnp.where(ok, t.rows * pad_cols + t.cols, pad_rows * pad_cols)
+    dense = jnp.full((pad_rows * pad_cols,), zero, t.vals.dtype)
+    dense = getattr(dense.at[flat], comb)(t.vals, mode="drop")
+    return dense.reshape(pad_rows, pad_cols)
+
+
+def support_window_counts(
+    bits: Array,
+    block_rows: int,
+    block_cols: int,
+    nrows: int,
+    ncols: int,
+) -> Array:
+    """Exact per-(row-block, col-window) output nnz from a packed support
+    bitmask (``spgemm_support_bits`` / ``pack_support_bits`` layout):
+    [nblocks, ncolwin] int32 — the oracle seeding of the 2D windowed
+    plan (out caps become exact counts instead of clamped-flops bounds).
+
+    ``block_cols`` must be word-aligned (multiple of 32) so every window
+    covers whole uint32 words; bits past ``ncols`` are never set by the
+    packers, so no tail masking is needed.
+    """
+    assert block_cols % 32 == 0, block_cols
+    m, nw = bits.shape
+    assert m == nrows, (m, nrows)
+    nblocks = -(-nrows // block_rows)
+    ncw = -(-ncols // block_cols)
+    wpc = lax.population_count(bits).astype(jnp.int32)  # [m, nw]
+    hid = (jnp.arange(nw, dtype=jnp.int32) * 32) // block_cols
+    onehot = (hid[:, None] == jnp.arange(ncw, dtype=jnp.int32)[None, :])
+    per_rh = jnp.dot(
+        wpc.astype(jnp.float32), onehot.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    ).astype(jnp.int32)  # [m, ncw] (exact: counts < 2^24)
+    g = jnp.arange(m, dtype=jnp.int32) // block_rows
+    return jax.ops.segment_sum(per_rh, g, num_segments=nblocks)
+
+
 # --- bit-packed output-support oracle ---------------------------------------
 
 
@@ -587,10 +648,21 @@ def spgemm_support_bits(
     kpad = -(-k // 128) * 128
     npad = -(-n // 128) * 128
     nw = -(-n // 32)
-    da = densify(a.apply(lambda v: jnp.ones_like(v)), -(-m // row_block) * row_block, kpad, 0)
-    db = densify(b.apply(lambda v: jnp.ones_like(v)), kpad, npad, 0)
-    da = jnp.minimum(da, 1).astype(jnp.bfloat16)
-    db = jnp.minimum(db, 1).astype(jnp.bfloat16)
+
+    def support_dense(t: SpTuples, R: int, C: int) -> Array:
+        # 0/1 support via scatter-ADD + clamp: duplicate-entry safe
+        # (densify's unique_indices contract would be violated by
+        # repeated slots) and sort-free.
+        flat = jnp.where(t.valid_mask(), t.rows * C + t.cols, R * C)
+        d = jnp.zeros((R * C,), jnp.float32).at[flat].add(
+            1.0, mode="drop"
+        )
+        return jnp.minimum(d, 1.0).reshape(R, C)
+
+    da = support_dense(a, -(-m // row_block) * row_block, kpad)
+    db = support_dense(b, kpad, npad)
+    da = da.astype(jnp.bfloat16)
+    db = db.astype(jnp.bfloat16)
     lanes = jnp.arange(32, dtype=jnp.uint32)
     out_bits = []
     out_cnt = []

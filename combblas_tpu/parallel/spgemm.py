@@ -461,6 +461,229 @@ def summa_rowblock_flops_host(
     )
 
 
+@partial(
+    jax.jit, static_argnames=("block_rows", "block_cols", "chunk_w")
+)
+def summa_window_flops_pair(
+    A: SpParMat, B: SpParMat, block_rows: int, block_cols: int,
+    chunk_w: int = 1,
+) -> jax.Array:
+    """[2, nblocks, ncolwin, p, pr, pc]: the 2D-resolved symbolic pass —
+    flop counts per (A row block, B col window) per stage per output
+    tile; index 0 is ``chunk_w``-padded, index 1 the true counts (one
+    pass, like ``summa_rowblock_flops_pair``).
+
+    This is what sizes the 2D ``dot`` backend: per-window output bounds
+    and the 2D skip list (a window with zero symbolic flops produces
+    nothing — its stage matmuls and its extraction scan are both
+    elided at trace time).
+    """
+    _check_compat(A, B)
+    grid = A.grid
+    p = grid.pr
+    lrA = A.local_rows
+    lrB, lcB = B.local_rows, B.local_cols
+    nblocks = -(-lrA // block_rows)
+    ncw = -(-lcB // block_cols)
+
+    def body(ar, ac, br, bc):
+        a_rows, a_cols = ar[0, 0], ac[0, 0]
+        b_rows, b_cols = br[0, 0], bc[0, 0]
+        ag_rows = lax.all_gather(a_rows, COL_AXIS)
+        ag_cols = lax.all_gather(a_cols, COL_AXIS)
+        bg_rows = lax.all_gather(b_rows, ROW_AXIS)
+        bg_cols = lax.all_gather(b_cols, ROW_AXIS)
+        per_stage = []
+        for s in range(p):
+            b_valid = bg_rows[s] < lrB
+            # per-(col-window, B-row) walk lengths; invalid entries fall
+            # in the ncw overflow bucket (a sentinel col == lcB would
+            # otherwise land in the last window when block_cols ∤ lcB)
+            h = jnp.where(
+                b_valid, bg_cols[s] // block_cols, ncw
+            ).astype(jnp.int32)
+            key = h * (lrB + 1) + jnp.minimum(bg_rows[s], lrB)
+            blens2 = jax.ops.segment_sum(
+                b_valid.astype(jnp.int32), key,
+                num_segments=(ncw + 1) * (lrB + 1),
+            ).reshape(ncw + 1, lrB + 1)
+            a_valid = ag_rows[s] < lrA
+            k = jnp.minimum(ag_cols[s], lrB)
+            g = jnp.where(a_valid, ag_rows[s] // block_rows, nblocks)
+            # chunk_w == 1 padding is the identity: run the inner
+            # gather+segment loop once and reuse it for both variants
+            # (the dot-backend sizing path never consumes the padded
+            # counts, so it requests chunk_w=1)
+            variants = (
+                (blens2,) if chunk_w == 1
+                else (-(-blens2 // chunk_w) * chunk_w, blens2)
+            )
+            both = []
+            for bl in variants:
+                per_h = []
+                for hh in range(ncw):  # static loop bounds memory to
+                    per_entry = jnp.where(  # one [nnzA] gather per window
+                        a_valid, bl[hh, k], 0
+                    ).astype(jnp.float32)
+                    per_h.append(
+                        jax.ops.segment_sum(
+                            per_entry, g, num_segments=nblocks + 1
+                        )[:nblocks]
+                    )
+                both.append(jnp.stack(per_h, axis=1))  # [nblocks, ncw]
+            if len(both) == 1:
+                both = [both[0], both[0]]
+            per_stage.append(jnp.stack(both))  # [2, nblocks, ncw]
+        mine = jnp.stack(per_stage)  # [p, 2, nblocks, ncw]
+        g2 = lax.all_gather(lax.all_gather(mine, COL_AXIS), ROW_AXIS)
+        # [pr, pc, p, 2, nblocks, ncw] -> [2, nblocks, ncw, p, pr, pc]
+        return jnp.transpose(g2, (3, 4, 5, 2, 0, 1))
+
+    return jax.shard_map(
+        body,
+        mesh=grid.mesh,
+        in_specs=(TILE_SPEC,) * 4,
+        out_specs=P(),
+        check_vma=False,
+    )(A.rows, A.cols, B.rows, B.cols)
+
+
+def summa_window_flops_host(
+    grid, rows_a, cols_a, rows_b, cols_b,
+    nrows_a: int, ncols_a: int, ncols_b: int,
+    block_rows: int, block_cols: int, chunk_w: int = 0,
+) -> np.ndarray:
+    """Host-numpy twin of ``summa_window_flops_pair`` (one chunk_w at a
+    time): [nblocks, ncolwin, p, pr, pc] float64 from global COO arrays,
+    zero device interaction — the axon-safe 2D sizing path."""
+    pr_, pc_ = grid.pr, grid.pc
+    assert pr_ == pc_, "SUMMA requires a square grid"
+    p = pr_
+    lrA = grid.local_rows(nrows_a)
+    lcA = grid.local_cols(ncols_a)
+    lrB = grid.local_rows(ncols_a)
+    lcB = grid.local_cols(ncols_b)
+    assert lcA == lrB, "A col-blocking must equal B row-blocking"
+    nblocks = -(-lrA // block_rows)
+    ncw = -(-lcB // block_cols)
+    rows_a = np.asarray(rows_a, np.int64)
+    cols_a = np.asarray(cols_a, np.int64)
+    rows_b = np.asarray(rows_b, np.int64)
+    cols_b = np.asarray(cols_b, np.int64)
+    ia, sa, ka = rows_a // lrA, cols_a // lcA, cols_a % lcA
+    g = (rows_a % lrA) // block_rows
+    countA = np.bincount(
+        (((ia * p + sa) * nblocks) + g) * lcA + ka,
+        minlength=p * p * nblocks * lcA,
+    ).reshape(p, p, nblocks, lcA)
+    sb, kb = rows_b // lrB, rows_b % lrB
+    jb = cols_b // lcB
+    hb = (cols_b % lcB) // block_cols
+    countB = np.bincount(
+        (((sb * p + jb) * ncw) + hb) * lrB + kb,
+        minlength=p * p * ncw * lrB,
+    ).reshape(p, p, ncw, lrB)
+    if chunk_w:
+        countB = -(-countB // chunk_w) * chunk_w
+    # flops[g, h, s, i, j] = sum_k countA[i,s,g,k] * countB[s,j,h,k]
+    return np.einsum(
+        "isgk,sjhk->ghsij",
+        countA.astype(np.float64), countB.astype(np.float64),
+    )
+
+
+@partial(jax.jit, static_argnames=("block_cols",))
+def summa_window_bnnz(B: SpParMat, block_cols: int) -> jax.Array:
+    """[pr, pc, ncolwin] int32, replicated: B-tile nnz per col window —
+    the static gather capacity of the 2D dot backend's CSC panel slices
+    (``panel_cap`` = global max)."""
+    lrB, lcB = B.local_rows, B.local_cols
+    ncw = -(-lcB // block_cols)
+
+    def body(br, bc):
+        b_rows, b_cols = br[0, 0], bc[0, 0]
+        valid = b_rows < lrB
+        h = jnp.where(valid, b_cols // block_cols, ncw).astype(jnp.int32)
+        mine = jax.ops.segment_sum(
+            valid.astype(jnp.int32), h, num_segments=ncw + 1
+        )[:ncw]
+        g2 = lax.all_gather(lax.all_gather(mine, COL_AXIS), ROW_AXIS)
+        return g2  # [pr, pc, ncw]
+
+    return jax.shard_map(
+        body,
+        mesh=B.grid.mesh,
+        in_specs=(TILE_SPEC,) * 2,
+        out_specs=P(),
+        check_vma=False,
+    )(B.rows, B.cols)
+
+
+def summa_window_bnnz_host(
+    grid, rows_b, cols_b, ncols_a: int, ncols_b: int, block_cols: int
+) -> np.ndarray:
+    """Host twin of ``summa_window_bnnz``: [pr, pc, ncolwin]."""
+    lrB = grid.local_rows(ncols_a)
+    lcB = grid.local_cols(ncols_b)
+    ncw = -(-lcB // block_cols)
+    rows_b = np.asarray(rows_b, np.int64)
+    cols_b = np.asarray(cols_b, np.int64)
+    sb, jb = rows_b // lrB, cols_b // lcB
+    hb = (cols_b % lcB) // block_cols
+    return np.bincount(
+        ((sb * grid.pc + jb) * ncw) + hb,
+        minlength=grid.pr * grid.pc * ncw,
+    ).reshape(grid.pr, grid.pc, ncw)
+
+
+def windowed_plan_2d(
+    per_window_padded: np.ndarray | None,
+    per_window_true: np.ndarray,
+    block_rows: int,
+    block_cols: int,
+    local_rows: int,
+    local_cols_b: int,
+    slack: float = 1.02,
+) -> tuple[tuple, tuple, tuple]:
+    """2D twin of ``windowed_plan``: per-(row-block, col-window) static
+    (flop_caps, out_caps, skip), each a tuple of per-block tuples.
+
+    Out caps are the clamped-flops bound per WINDOW (true per-tile
+    window flops, max over tiles, clamped by the window's dense cells);
+    a window whose symbolic count is zero is skipped — its stage
+    matmuls, its B panel, and its extraction scan are never emitted.
+    ``per_window_padded`` may be ``None``: the ``dot`` backend does no
+    chunked expansion, so its flop caps are never consumed — passing
+    None (all-ones caps) saves the padded symbolic pass entirely (the
+    device pair computes both in one pass; the HOST sizing path has to
+    run one einsum per variant, so benchmarks skip the dead one).
+    """
+    pt = np.asarray(per_window_true, np.float64)
+    pb = (
+        None if per_window_padded is None
+        else np.asarray(per_window_padded, np.float64)
+    )
+    nblocks, ncw = pt.shape[0], pt.shape[1]
+    flop_caps, out_caps, skip = [], [], []
+    for g in range(nblocks):
+        rb = min(block_rows, local_rows - g * block_rows)
+        fr, orow, sr_ = [], [], []
+        for h in range(ncw):
+            wc = min(block_cols, local_cols_b - h * block_cols)
+            cells = rb * wc
+            tot = pt[g, h].sum(axis=0).max()  # per-tile total, max
+            sr_.append(bool(tot <= 0))
+            fr.append(
+                1 if pb is None
+                else max(int(pb[g, h].max() * slack) + 1, 1)
+            )
+            orow.append(max(min(int(tot * slack) + 1, cells), 1))
+        flop_caps.append(tuple(fr))
+        out_caps.append(tuple(orow))
+        skip.append(tuple(sr_))
+    return tuple(flop_caps), tuple(out_caps), tuple(skip)
+
+
 def windowed_plan(
     per_block_padded: np.ndarray,
     per_block_true: np.ndarray,
@@ -495,11 +718,65 @@ def windowed_plan(
     return tuple(flop_caps), tuple(out_caps), tuple(skip)
 
 
+def _shift_rowblock(am: SpTuples, lo, arows: int) -> SpTuples:
+    """Row-block tile → block-local coordinates: valid rows shift down
+    by ``lo``; invalid slots land EXACTLY at the new sentinel ``arows``
+    (= the padded block height) so ``valid_mask`` stays false after the
+    ``nrows`` rewrite.  Shared by the fused and local dot kernels."""
+    import dataclasses as _dc
+
+    valid = am.valid_mask()
+    a_loc = _dc.replace(am, rows=jnp.where(valid, am.rows - lo, arows))
+    return _dc.replace(a_loc, nrows=arows)
+
+
+def _dense_col_panel(
+    sr: Semiring, bs: SpTuples, starts, h: int, block_cols: int,
+    pk: int, pwin: int, panel_cap: int,
+):
+    """Dense [pk, pwin] panel of B col window ``h`` from the col-major-
+    sorted stage tile ``bs``: the window's entries occupy one contiguous
+    CSC slot range [starts[h], starts[h+1]), gathered with a static
+    ``panel_cap``-slot slice and scattered with the semiring combiner —
+    O(panel_cap) work per window (not O(nnz)), duplicate-entry safe.
+    This is the stage operand of the 2D ``dot`` backend: peak memory
+    pk × pwin cells, bounded by the column window instead of B's tile
+    width."""
+    from ..ops.spgemm import scatter_combine_for
+
+    start = starts[h]
+    idx = start + jnp.arange(panel_cap, dtype=jnp.int32)
+    ok = idx < starts[h + 1]
+    ii = jnp.minimum(idx, bs.capacity - 1)
+    r = bs.rows[ii]
+    c = bs.cols[ii]
+    v = bs.vals[ii]
+    ok = ok & (r < bs.nrows)
+    flat = jnp.where(ok, r * pwin + (c - h * block_cols), pk * pwin)
+    comb = scatter_combine_for(sr)
+    dense = jnp.full((pk * pwin,), sr.zero(bs.vals.dtype), bs.vals.dtype)
+    dense = getattr(dense.at[flat], comb)(v, mode="drop")
+    return dense.reshape(pk, pwin)
+
+
+def _window_stage_product(
+    sr: Semiring, kind: str, da, panel, mode: str, interpret: bool,
+):
+    """One stage's dense window product on the matrix unit."""
+    from ..ops.pallas_kernels import semiring_matmul
+
+    if kind == "plus_times":
+        return _mxu_dot(da, panel, mode, da.dtype)
+    return semiring_matmul(
+        kind, da, panel, bm=256, bk=512, bn=256, interpret=interpret
+    )
+
+
 @partial(
     jax.jit,
     static_argnames=(
         "sr", "block_rows", "flop_caps", "out_caps", "skip", "backend",
-        "mode", "chunk_w", "interpret",
+        "mode", "chunk_w", "interpret", "block_cols", "panel_cap",
     ),
 )
 def summa_spgemm_windowed(
@@ -515,6 +792,8 @@ def summa_spgemm_windowed(
     mode: str = "f32",
     chunk_w: int = 8,
     interpret: bool = False,
+    block_cols: int | None = None,
+    panel_cap: int | None = None,
 ) -> tuple[SpParMat, jax.Array]:
     """Sort-free SUMMA over dense ROW-BLOCK accumulators — the mid-scale
     general sparse-output tier.
@@ -532,39 +811,45 @@ def summa_spgemm_windowed(
                 ``at[].{add,min,max}`` per stage (ops/spgemm.
                 accumulate_block_scatter) — the general path on backends
                 with a scatter unit (XLA:CPU);
-            backend="dot": densified stage tiles × `_mxu_dot` /
+            backend="dot": densified stage operands × `_mxu_dot` /
                 the Pallas semiring matmul — the MXU path
-                (``summa_spgemm_mxu`` generalized to row blocks so the
-                dense ACCUMULATOR no longer needs the whole tile in
-                HBM; the dense B stage operand still does, which is why
-                the router only auto-picks this backend inside the mxu
-                envelope).  Like the mxu tier, the dot backend REQUIRES
-                unique-entry tiles (``densify``'s unique_indices
-                scatter); only the scatter backend absorbs duplicate
-                COO entries exactly.
-        extract acc[g] with the windowed output-driven extraction
+                (``summa_spgemm_mxu`` generalized to row blocks).  With
+                ``block_cols=None`` the dense B stage operand spans the
+                whole tile width (legacy 1D form — only fits inside the
+                mxu envelope); with ``block_cols`` set the output is
+                tiled into (row block × col window) 2D windows and each
+                stage densifies only B's COLUMN PANEL for the current
+                window (CSC slot-range slice → [pk, pwin] dense panel,
+                ``_dense_col_panel``), so peak stage-operand memory is
+                pk × pwin cells — bounded by the window, which is what
+                makes this the TPU mid-scale tier.  Both dot forms
+                densify with the semiring's combining scatter
+                (``densify_combine``), so duplicate-entry COO inputs
+                are absorbed exactly on EVERY windowed backend; only
+                the mxu tier keeps the unique-entries precondition.
+        extract acc with the windowed output-driven extraction
         (``sparsify_windowed``), sized by the exact symbolic
-        per-block output bound (``windowed_plan``).
+        per-block (or per-window) output bound (``windowed_plan`` /
+        ``windowed_plan_2d``); symbolically-empty 2D windows are never
+        densified, matmul'd, or scanned.
 
-    Per-block capacities are trace-time constants; ``windowed_plan``
-    derives them (and the skip list) from ``summa_rowblock_flops`` /
-    ``summa_rowblock_flops_host``.  Returns (C, overflow) with the same
-    overflow contract as ``summa_spgemm_mxu`` — though with
-    symbolic-bound out_caps overflow is structurally zero (the bound
-    dominates the realized nnz).
+    In 2D form ``flop_caps``/``out_caps``/``skip`` are tuples of
+    per-block tuples from ``windowed_plan_2d`` and ``panel_cap`` bounds
+    one window's B-panel nnz (``summa_window_bnnz``).  Returns
+    (C, overflow) with the same overflow contract as
+    ``summa_spgemm_mxu`` — though with symbolic-bound out_caps overflow
+    is structurally zero (the bound dominates the realized nnz).
 
     The output tile's valid slots form a compacted PREFIX PER BLOCK
-    (globally row-ordered, padding interleaved between blocks), not one
-    global prefix — ``valid_mask`` semantics, which every downstream
+    (1D: globally row-ordered; 2D: row-block-major, then window-major
+    within a block — NOT globally row-sorted), with padding interleaved
+    between blocks — ``valid_mask`` semantics, which every downstream
     consumer (to_dense, CSR/CSC builds, ewise, redistribute) honors;
     a global re-sort would reintroduce the cost this kernel removes.
     """
-    import dataclasses as _dc
-
-    from ..ops.pallas_kernels import semiring_matmul
     from ..ops.spgemm import (
         accumulate_block_scatter,
-        densify,
+        densify_combine,
         mask_rows,
         scatter_combine_for,
         sparsify_windowed,
@@ -576,8 +861,10 @@ def summa_spgemm_windowed(
     lrA, lcA = A.local_rows, A.local_cols
     lrB, lcB = B.local_rows, B.local_cols
     nblocks = -(-lrA // block_rows)
+    two_d = backend == "dot" and block_cols is not None
+    ncw = -(-lcB // block_cols) if two_d else 1
     if skip is None:
-        skip = (False,) * nblocks
+        skip = ((False,) * ncw,) * nblocks if two_d else (False,) * nblocks
     assert len(flop_caps) == len(out_caps) == len(skip) == nblocks, (
         nblocks, len(flop_caps), len(out_caps), len(skip)
     )
@@ -587,8 +874,13 @@ def summa_spgemm_windowed(
             f"backend='dot' supports semirings {sorted(_PALLAS_KINDS)}; "
             f"got {sr.name}"
         )
+        assert scatter_combine_for(sr) is not None, sr.name
         pcols = _pad128(lcB)
         pk = _pad128(lrB)
+        if two_d:
+            assert panel_cap is not None and panel_cap >= 1
+            assert all(len(row) == ncw for row in skip), (ncw, skip)
+            pwin = _pad128(block_cols)
     else:
         assert backend == "scatter", backend
         assert scatter_combine_for(sr) is not None, (
@@ -597,7 +889,10 @@ def summa_spgemm_windowed(
         )
         pcols = -(-lcB // 128) * 128
     if obs.ENABLED:
-        obs.count("trace.summa_spgemm_windowed", backend=backend)
+        obs.count(
+            "trace.summa_spgemm_windowed",
+            backend=("dot2d" if two_d else backend),
+        )
     zero = float(np.asarray(sr.zero_fn(A.vals.dtype)))
 
     def body(ar, ac, av, an, br, bc, bv, bn):
@@ -607,18 +902,66 @@ def summa_spgemm_windowed(
         b_stages = _gather_stage_tiles(b_mine, ROW_AXIS, p)
         if backend == "scatter":
             b_sides = [CSR.from_tuples(b_stages[s]) for s in range(p)]
-        else:
+        elif not two_d:
             b_sides = [
-                densify(b_stages[s], pk, pcols, zero) for s in range(p)
+                densify_combine(sr, b_stages[s], pk, pcols)
+                for s in range(p)
             ]
+        else:
+            # col-major sort once per stage; each window's entries are
+            # then one contiguous slot range found by searchsorted
+            # (same preamble helper as the local fast path)
+            b_sorted, b_starts = zip(*(
+                _colmajor_with_starts(b_stages[s], block_cols)
+                for s in range(p)
+            ))
         chunks = []
         worst = jnp.int32(0)
         for g in range(nblocks):
-            if skip[g]:
+            if (all(skip[g]) if two_d else skip[g]):
                 continue
             lo = g * block_rows
             rb = min(block_rows, lrA - lo)
             arows = _pad128(rb) if backend == "dot" else rb
+            if two_d:
+                accs = {
+                    h: jnp.full((arows, pwin), zero, A.vals.dtype)
+                    for h in range(ncw) if not skip[g][h]
+                }
+                for s in range(p):
+                    am = mask_rows(a_stages[s], lo, lo + rb)
+                    da = densify_combine(
+                        sr, _shift_rowblock(am, lo, arows), arows, pk
+                    )
+                    for h in accs:
+                        panel = _dense_col_panel(
+                            sr, b_sorted[s], b_starts[s], h,
+                            block_cols, pk, pwin, panel_cap,
+                        )
+                        accs[h] = sr.add(
+                            accs[h],
+                            _window_stage_product(
+                                sr, kind, da, panel, mode, interpret
+                            ),
+                        )
+                for h, acc in accs.items():
+                    wc = min(block_cols, lcB - h * block_cols)
+                    t_blk, total = sparsify_windowed(
+                        acc, zero, rb, wc, out_caps[g][h]
+                    )
+                    worst = jnp.maximum(worst, total - out_caps[g][h])
+                    vm = t_blk.valid_mask()
+                    chunks.append(
+                        SpTuples(
+                            rows=jnp.where(vm, t_blk.rows + lo, lrA),
+                            cols=jnp.where(
+                                vm, t_blk.cols + h * block_cols, lcB
+                            ),
+                            vals=t_blk.vals, nnz=t_blk.nnz,
+                            nrows=lrA, ncols=lcB,
+                        )
+                    )
+                continue
             acc = jnp.full((arows, pcols), zero, A.vals.dtype)
             for s in range(p):
                 am = mask_rows(a_stages[s], lo, lo + rb)
@@ -629,21 +972,15 @@ def summa_spgemm_windowed(
                         chunk_w=chunk_w,
                     )
                 else:
-                    valid = am.valid_mask()
-                    a_loc = _dc.replace(
-                        am,
-                        rows=jnp.where(valid, am.rows - lo, arows),
+                    da = densify_combine(
+                        sr, _shift_rowblock(am, lo, arows), arows, pk
                     )
-                    a_loc = _dc.replace(a_loc, nrows=arows)
-                    da = densify(a_loc, arows, pk, zero)
-                    if kind == "plus_times":
-                        prod = _mxu_dot(da, b_sides[s], mode, acc.dtype)
-                    else:
-                        prod = semiring_matmul(
-                            kind, da, b_sides[s], bm=256, bk=512, bn=256,
-                            interpret=interpret,
-                        )
-                    acc = sr.add(acc, prod)
+                    acc = sr.add(
+                        acc,
+                        _window_stage_product(
+                            sr, kind, da, b_sides[s], mode, interpret
+                        ),
+                    )
             t_blk, total = sparsify_windowed(
                 acc, zero, rb, lcB, out_caps[g]
             )
@@ -1244,6 +1581,48 @@ WINDOWED_MAX_BLOCKS = 32
 #: SLOT, so the narrow window keeps slot padding ~1.1x on R-MAT degree
 #: tails (vs ~2x at the gather-bound ESC default of 32).
 WINDOWED_CHUNK_W = 8
+#: 2D ``dot`` backend envelope: one stage's dense B COLUMN PANEL
+#: (padded k × padded col window) may hold at most this many cells
+#: (2^27 ≈ 512 MB f32 / 256 MB bf16).  This is the cap that replaces
+#: "B's whole dense tile must fit" — the reason the router can now
+#: auto-route ``windowed`` on TPU above the mxu envelope.
+WINDOWED_MAX_PANEL_CELLS = 1 << 27
+#: Upper bound on the unrolled col-window count (program size, like
+#: ``WINDOWED_MAX_BLOCKS`` for row blocks).
+WINDOWED_MAX_COL_WINDOWS = 32
+
+
+def default_block_cols(local_rows_b: int, local_cols_b: int) -> int:
+    """Col-window width for the 2D ``dot`` backend: the widest
+    512-multiple whose dense B panel (padded-k × window) stays within
+    ``WINDOWED_MAX_PANEL_CELLS``, floored so at most
+    ``WINDOWED_MAX_COL_WINDOWS`` windows unroll into the program.
+
+    In the extreme region ``pad(k) · lcB > WINDOWED_MAX_COL_WINDOWS ·
+    WINDOWED_MAX_PANEL_CELLS`` the two bounds conflict and the window-
+    count floor wins (program size is a hard constraint; memory is the
+    caller's budget) — the router never auto-routes there
+    (``dot_panel_feasible`` gates it to scan), so only forced calls can
+    exceed the envelope."""
+    pk = _pad128(local_rows_b)
+    bc = max((WINDOWED_MAX_PANEL_CELLS // pk) // 512 * 512, 512)
+    floor_bc = -(-local_cols_b // WINDOWED_MAX_COL_WINDOWS)
+    bc = max(bc, -(-floor_bc // 512) * 512)
+    return min(bc, max(local_cols_b, 1))
+
+
+def dot_panel_feasible(k_dim: int, n_dim: int | None = None) -> bool:
+    """True iff a col window exists that fits the stage-operand
+    envelope (``WINDOWED_MAX_PANEL_CELLS``) WITHOUT exceeding the
+    unrolled-window budget: the narrowest admissible window is 512
+    cols, raised to ``ceil(n / WINDOWED_MAX_COL_WINDOWS)`` when B's
+    tile width is known (``default_block_cols`` floors there to bound
+    program size, so the envelope must hold at that width too)."""
+    win = 512
+    if n_dim is not None:
+        floor_bc = -(-n_dim // WINDOWED_MAX_COL_WINDOWS)
+        win = max(win, -(-floor_bc // 512) * 512)
+    return _pad128(k_dim) * win <= WINDOWED_MAX_PANEL_CELLS
 
 
 def default_block_rows(local_rows: int, local_cols_b: int) -> int:
@@ -1302,6 +1681,82 @@ def _local_csr(t: SpTuples) -> CSR:
     return CSR.from_tuples(t)
 
 
+@partial(jax.jit, static_argnames=("block_cols",))
+def _colmajor_with_starts(t: SpTuples, block_cols: int):
+    """Col-major-sorted tile + per-window CSC slot starts (the panel
+    slicing preamble of the 2D dot backend, hoisted out of the per-block
+    programs on the local fast path)."""
+    ts = t.sort_colmajor()
+    ncw = -(-t.ncols // block_cols)
+    bounds = jnp.minimum(
+        jnp.arange(ncw + 1, dtype=jnp.int32) * block_cols, t.ncols
+    )
+    starts = jnp.searchsorted(ts.cols, bounds, side="left").astype(
+        jnp.int32
+    )
+    return ts, starts
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "sr", "rb", "out_caps_row", "skip_row", "block_cols", "pk",
+        "pwin", "panel_cap", "mode", "interpret",
+    ),
+)
+def _windowed_block_local_dot(
+    sr: Semiring,
+    a: SpTuples,
+    bs: SpTuples,
+    b_starts,
+    lo,
+    *,
+    rb: int,
+    out_caps_row: tuple,
+    skip_row: tuple,
+    block_cols: int,
+    pk: int,
+    pwin: int,
+    panel_cap: int,
+    mode: str,
+    interpret: bool,
+):
+    """One ROW BLOCK of the local 2D ``dot`` tier: all of the block's
+    non-skipped col windows in one small program (single device → single
+    stage, so the accumulator is the stage product itself).  ``lo`` is
+    traced so blocks with the same static signature share a compile."""
+    from ..ops.spgemm import densify_combine, mask_rows, sparsify_windowed
+
+    lrA, lcB = a.nrows, bs.ncols
+    kind = _PALLAS_KINDS[sr.name]
+    arows = _pad128(rb)
+    zero = float(np.asarray(sr.zero_fn(a.vals.dtype)))
+    am = mask_rows(a, lo, lo + rb)
+    da = densify_combine(sr, _shift_rowblock(am, lo, arows), arows, pk)
+    rows_l, cols_l, vals_l = [], [], []
+    nnz = jnp.int32(0)
+    worst = jnp.int32(0)
+    for h in range(len(skip_row)):
+        if skip_row[h]:
+            continue
+        panel = _dense_col_panel(
+            sr, bs, b_starts, h, block_cols, pk, pwin, panel_cap
+        )
+        prod = _window_stage_product(sr, kind, da, panel, mode, interpret)
+        wc = min(block_cols, lcB - h * block_cols)
+        t, total = sparsify_windowed(prod, zero, rb, wc, out_caps_row[h])
+        worst = jnp.maximum(worst, total - out_caps_row[h])
+        vm = t.valid_mask()
+        rows_l.append(jnp.where(vm, t.rows + lo, lrA))
+        cols_l.append(jnp.where(vm, t.cols + h * block_cols, lcB))
+        vals_l.append(t.vals)
+        nnz = nnz + t.nnz
+    return (
+        jnp.concatenate(rows_l), jnp.concatenate(cols_l),
+        jnp.concatenate(vals_l), nnz, worst,
+    )
+
+
 def local_spgemm_windowed(
     sr: Semiring,
     A: SpParMat,
@@ -1312,6 +1767,11 @@ def local_spgemm_windowed(
     out_caps: tuple,
     skip: tuple,
     chunk_w: int = 8,
+    backend: str = "scatter",
+    block_cols: int | None = None,
+    panel_cap: int | None = None,
+    mode: str = "f32",
+    interpret: bool = False,
 ) -> tuple[SpParMat, jax.Array]:
     """Single-device (1x1 grid) fast path of the windowed tier: a HOST
     loop dispatching one small compiled program PER ROW BLOCK instead of
@@ -1325,23 +1785,45 @@ def local_spgemm_windowed(
     shard_map kernel (``summa_spgemm_windowed``) remains the multi-device
     path where the stage collectives must live inside one program.
 
-    Same plan/caps contract and return shape as ``summa_spgemm_windowed``
-    (scatter backend only — the dot backend's envelope is the mxu tier).
+    Same plan/caps contract and return shape as ``summa_spgemm_windowed``.
+    ``backend="dot"`` requires ``block_cols``/``panel_cap`` and 2D caps
+    from ``windowed_plan_2d`` — each row block's program covers its
+    non-skipped col windows (``_windowed_block_local_dot``).
     """
     assert A.grid.size == 1 and B.grid.size == 1
     _check_compat(A, B)
     lrA, lcB = A.local_rows, B.local_cols
     a = A.local_tile(A.rows, A.cols, A.vals, A.nnz)
     bt = B.local_tile(B.rows, B.cols, B.vals, B.nnz)
-    b_csr = _local_csr(bt)
+    if backend == "dot":
+        assert block_cols is not None and panel_cap is not None
+        bs, b_starts = _colmajor_with_starts(bt, block_cols)
+        pk = _pad128(B.local_rows)
+        pwin = _pad128(block_cols)
+    else:
+        assert backend == "scatter", backend
+        b_csr = _local_csr(bt)
     rows_l, cols_l, vals_l = [], [], []
     nnz = None
     worst = jnp.int32(0)
     for g, (fc, oc, sk) in enumerate(zip(flop_caps, out_caps, skip)):
-        if sk:
+        if (all(sk) if backend == "dot" else sk):
             continue
         lo = g * block_rows
         rb = min(block_rows, lrA - lo)
+        if backend == "dot":
+            r, c, v, nz, over = _windowed_block_local_dot(
+                sr, a, bs, b_starts, jnp.int32(lo), rb=rb,
+                out_caps_row=oc, skip_row=sk, block_cols=block_cols,
+                pk=pk, pwin=pwin, panel_cap=panel_cap, mode=mode,
+                interpret=interpret,
+            )
+            rows_l.append(r)
+            cols_l.append(c)
+            vals_l.append(v)
+            nnz = nz if nnz is None else nnz + nz
+            worst = jnp.maximum(worst, over)
+            continue
         r, c, v, nz, total = _windowed_block_local(
             sr, a, b_csr, jnp.int32(lo), rb=rb,
             flop_cap=max(fc, chunk_w), out_cap=oc, chunk_w=chunk_w,
@@ -1366,26 +1848,184 @@ def local_spgemm_windowed(
     return mat, worst
 
 
+def resolve_spgemm_backend(backend: str | None = None) -> str:
+    """Accumulate-backend resolution, shared by the router and the sized
+    entries: explicit argument > ``COMBBLAS_SPGEMM_BACKEND`` env > the
+    platform default (``dot`` on TPU — no scatter unit — ``scatter``
+    elsewhere)."""
+    import os
+
+    if backend is None:
+        backend = os.environ.get("COMBBLAS_SPGEMM_BACKEND") or None
+    if backend is None:
+        backend = "dot" if jax.default_backend() == "tpu" else "scatter"
+    assert backend in ("dot", "scatter"), backend
+    return backend
+
+
+def panel_cap_from_bnnz(bnnz, capacity: int) -> int:
+    """Static panel slice capacity from the per-(tile, window) B nnz
+    counts: pow2-rounded max (compile reuse across inputs), clamped to
+    the tile capacity (a slice can never hold more slots than exist)."""
+    m = int(np.asarray(bnnz).max())
+    return max(min(1 << max(m - 1, 1).bit_length(), capacity), 1)
+
+
+def _oracle_out_caps_2d(
+    sr, A: SpParMat, B: SpParMat, block_rows: int, block_cols: int,
+    out_caps: tuple, skip: tuple,
+) -> tuple[tuple, tuple]:
+    """Tighten the 2D plan with the bit-packed support oracle
+    (``spgemm_support_bits`` → ``support_window_counts``): per-window
+    out caps become EXACT output counts instead of clamped-flops bounds
+    (smaller extraction capacities / tighter col-window occupancy).
+    Single-device only (the oracle computes a whole-matrix mask), and
+    only sensible inside its dense envelope — callers gate on size."""
+    from ..ops.spgemm import spgemm_support_bits, support_window_counts
+
+    assert A.grid.size == 1 and block_cols % 32 == 0
+    a = A.local_tile(A.rows, A.cols, A.vals, A.nnz)
+    b = B.local_tile(B.rows, B.cols, B.vals, B.nnz)
+    bits, _ = spgemm_support_bits(a, b)
+    cnt = np.asarray(
+        jax.device_get(
+            support_window_counts(
+                bits, block_rows, block_cols, A.local_rows, B.local_cols
+            )
+        )
+    )
+    new_caps, new_skip = [], []
+    for g in range(len(out_caps)):
+        row_c, row_s = [], []
+        for h in range(len(out_caps[g])):
+            exact = int(cnt[g, h])
+            row_c.append(max(min(out_caps[g][h], exact), 1))
+            row_s.append(bool(skip[g][h] or exact == 0))
+        new_caps.append(tuple(row_c))
+        new_skip.append(tuple(row_s))
+    return tuple(new_caps), tuple(new_skip)
+
+
 def spgemm_windowed(
     sr: Semiring,
     A: SpParMat,
     B: SpParMat,
     *,
     block_rows: int | None = None,
+    block_cols: int | None = None,
     backend: str | None = None,
     mode: str = "f32",
     slack: float = 1.02,
     interpret: bool = False,
+    oracle: bool = False,
 ) -> SpParMat:
-    """Sized entry for the windowed tier: device symbolic row-block pass
-    → ``windowed_plan`` → ``summa_spgemm_windowed`` (one host readback
-    for sizing; benchmarks on readback-poisoned hardware size on host via
-    ``summa_rowblock_flops_host`` + ``windowed_plan`` instead)."""
-    if backend is None:
-        backend = "dot" if jax.default_backend() == "tpu" else "scatter"
+    """Sized entry for the windowed tier: device symbolic pass →
+    ``windowed_plan`` (scatter, 1D) or ``windowed_plan_2d`` (dot, 2D) →
+    the matching kernel (one host readback for sizing; benchmarks on
+    readback-poisoned hardware size on host via
+    ``summa_rowblock_flops_host`` / ``summa_window_flops_host`` +
+    ``summa_window_bnnz_host`` instead).
+
+    ``oracle=True`` (dot, single device, inside the support-oracle
+    envelope) replaces the clamped-flops out caps with the EXACT
+    per-window output counts from the bit-packed support oracle.
+    """
+    backend = resolve_spgemm_backend(backend)
     if block_rows is None:
         block_rows = default_block_rows(A.local_rows, B.local_cols)
     chunk_w = WINDOWED_CHUNK_W
+    if backend == "dot":
+        if block_cols is None:
+            block_cols = default_block_cols(B.local_rows, B.local_cols)
+        # chunk_w=1 (identity padding): the dot backend never consumes
+        # the padded counts, so the symbolic pass runs its inner
+        # gather+segment loop once instead of twice
+        pair = host_value(
+            summa_window_flops_pair(
+                A, B, block_rows, block_cols, chunk_w=1
+            )
+        )
+        pt = pair[1]
+        flop_caps, out_caps, skip = windowed_plan_2d(
+            None, pt, block_rows, block_cols,
+            A.local_rows, B.local_cols, slack=slack,
+        )
+        if oracle:
+            # the oracle densifies FULL bf16 supports (spgemm_support
+            # _bits) — only admissible inside the mxu-tier size
+            # envelope, on one device, with word-aligned windows
+            if (
+                A.grid.size == 1
+                and block_cols % 32 == 0
+                and max(A.local_rows, B.local_rows, B.local_cols)
+                <= MXU_MAX_TILE_DIM
+            ):
+                out_caps, skip = _oracle_out_caps_2d(
+                    sr, A, B, block_rows, block_cols, out_caps, skip
+                )
+            else:
+                # requested but inapplicable: fall back to the
+                # clamped-flops caps, observably (never silently)
+                if obs.ENABLED:
+                    obs.count("spgemm.windowed.oracle_skipped")
+        panel_cap = panel_cap_from_bnnz(
+            host_value(summa_window_bnnz(B, block_cols)),
+            int(B.capacity),
+        )
+        if obs.ENABLED:
+            nsk = sum(sum(row) for row in skip)
+            obs.count("spgemm.windowed.col_windows_skipped", nsk)
+            obs.gauge(
+                "spgemm.windowed.col_windows",
+                len(skip[0]) if skip else 0,
+            )
+            obs.gauge(
+                "spgemm.windowed.panel_cells",
+                _pad128(B.local_rows) * _pad128(block_cols),
+            )
+            obs.gauge("spgemm.windowed.blocks", len(skip))
+            # per-window symbolic mask density, averaged over the LIVE
+            # windows (the 2D analog of spgemm.auto.mask_density)
+            live_cells = live_bound = 0.0
+            per_tile = np.asarray(pt).sum(axis=2).max(axis=(-1, -2))
+            for g in range(len(skip)):
+                rb = min(block_rows, A.local_rows - g * block_rows)
+                for h in range(len(skip[g])):
+                    if skip[g][h]:
+                        continue
+                    wc = min(
+                        block_cols, B.local_cols - h * block_cols
+                    )
+                    live_cells += rb * wc
+                    live_bound += min(float(per_tile[g, h]), rb * wc)
+            obs.gauge(
+                "spgemm.windowed.window_density",
+                live_bound / live_cells if live_cells else 0.0,
+            )
+            obs.gauge(
+                "spgemm.auto.mask_density",
+                live_bound / max(A.local_rows * B.local_cols, 1),
+            )
+        if A.grid.size == 1:
+            C, overflow = local_spgemm_windowed(
+                sr, A, B, block_rows=block_rows, flop_caps=flop_caps,
+                out_caps=out_caps, skip=skip, backend="dot",
+                block_cols=block_cols, panel_cap=panel_cap, mode=mode,
+                interpret=interpret,
+            )
+        else:
+            C, overflow = summa_spgemm_windowed(
+                sr, A, B, block_rows=block_rows, flop_caps=flop_caps,
+                out_caps=out_caps, skip=skip, backend="dot", mode=mode,
+                chunk_w=chunk_w, interpret=interpret,
+                block_cols=block_cols, panel_cap=panel_cap,
+            )
+        over = int(overflow)
+        assert over <= 0, (
+            f"windowed tier overflowed its symbolic bound by {over}"
+        )
+        _record_realized_nnz(C)
+        return C
     # one symbolic pass yields both the padded (expansion-capacity) and
     # true (output-bound) counts
     pair = host_value(
@@ -1426,6 +2066,44 @@ def spgemm_windowed(
     return C
 
 
+def coo_has_duplicates(M: SpParMat) -> bool:
+    """True iff any tile holds a repeated (row, col) entry — the cheap
+    nnz-vs-dedup check guarding the mxu tier's unique-entries
+    precondition (``densify``'s unique_indices scatter).  One two-key
+    sort per tile + one host readback; only spent where a densifying
+    unique-indices tier is about to be chosen, and memoized on the
+    matrix object so iterative callers (warm-plan serving, algorithm
+    loops re-routing the same operand) pay the sort + D2H sync once
+    — the readback is the expensive part on the target chip (bench.py
+    axon D2H rule)."""
+    from ..ops.spgemm import coo_sort_dedup
+
+    cached = getattr(M, "_coo_has_duplicates", None)
+    if cached is not None:
+        return cached
+    lr = M.local_rows
+
+    def body(r, c):
+        rows, cols = r[0, 0], c[0, 0]
+        rs, _, dup = coo_sort_dedup(rows, cols)
+        # padding slots (row == lr) are mutually equal — exclude them
+        mine = jnp.sum((dup & (rs < lr)).astype(jnp.int32))
+        return lax.psum(lax.psum(mine, ROW_AXIS), COL_AXIS)
+
+    total = jax.shard_map(
+        body,
+        mesh=M.grid.mesh,
+        in_specs=(TILE_SPEC,) * 2,
+        out_specs=P(),
+        check_vma=False,
+    )(M.rows, M.cols)
+    result = int(np.asarray(host_value(total))) > 0
+    # frozen dataclass: bypass via object.__setattr__ (the attr is not
+    # a pytree field, so transforms/copies simply drop it)
+    object.__setattr__(M, "_coo_has_duplicates", result)
+    return result
+
+
 def choose_tier_from_counts(
     sr: Semiring,
     max_tile_dim: int,
@@ -1433,23 +2111,40 @@ def choose_tier_from_counts(
     pr: int,
     flops_total: float,
     backend: str | None = None,
+    k_dim: int | None = None,
+    allow_mxu: bool = True,
+    n_dim: int | None = None,
 ) -> str:
     """Pure tier gate over pre-computed counts — shared by the device
     router (``choose_spgemm_tier``) and host-sizing benchmark drivers
     (which must not touch the device to decide).  See
-    ``choose_spgemm_tier`` for the rule."""
+    ``choose_spgemm_tier`` for the rule.  ``k_dim`` is B's local row
+    count and ``n_dim`` B's local col count (the dot backend's
+    panel-feasibility check — ``dot_panel_feasible``; ``k_dim``
+    defaults to ``max_tile_dim``); ``allow_mxu=False`` re-evaluates the
+    ladder with the mxu rung removed (the duplicate-entry fallback)."""
     from ..ops.spgemm import scatter_combine_for
 
-    if backend is None:
-        backend = "dot" if jax.default_backend() == "tpu" else "scatter"
-    if max_tile_dim <= MXU_MAX_TILE_DIM and sr.name in _PALLAS_KINDS:
-        return "mxu"
+    backend = resolve_spgemm_backend(backend)
     if (
-        backend == "scatter"
-        and scatter_combine_for(sr) is not None
+        allow_mxu
+        and max_tile_dim <= MXU_MAX_TILE_DIM
+        and sr.name in _PALLAS_KINDS
+    ):
+        return "mxu"
+    dense_ok = (
+        scatter_combine_for(sr) is not None
         and tile_cells <= WINDOWED_MAX_TILE_CELLS
         and tile_cells * pr * pr
         <= WINDOWED_MAX_CELLS_PER_FLOP * max(flops_total, 1.0)
+    )
+    if backend == "scatter" and dense_ok:
+        return "windowed"
+    if (
+        backend == "dot"
+        and dense_ok
+        and sr.name in _PALLAS_KINDS
+        and dot_panel_feasible(k_dim or max_tile_dim, n_dim)
     ):
         return "windowed"
     return "scan"
@@ -1461,42 +2156,71 @@ def choose_spgemm_tier(
     B: SpParMat,
     *,
     backend: str | None = None,
+    assume_unique: bool = False,
 ) -> str:
     """The routing rule of ``spgemm_auto`` (host-side, observable):
 
-      "mxu"       tiles fit the full-dense MXU envelope and the semiring
-                  has a dense kernel — the round-4 one-extraction path;
-      "windowed"  the backend is scatter-capable (non-TPU; the dot
-                  backend's dense B stage tiles only fit inside the mxu
-                  envelope, so the router never auto-picks windowed on
-                  TPU — docs/spgemm.md), the add monoid has a native
-                  scatter combiner, the per-tile dense cell count is
-                  bounded, and the output is dense enough that one cell
-                  scan beats the ESC sort
-                  (``WINDOWED_MAX_CELLS_PER_FLOP``);
+      "mxu"       tiles fit the full-dense MXU envelope, the semiring
+                  has a dense kernel, and the tiles hold UNIQUE entries
+                  (checked via ``coo_has_duplicates`` unless
+                  ``assume_unique`` — duplicate tiles would corrupt the
+                  unique-indices densify, so they fall back to the
+                  duplicate-absorbing windowed/scan rungs);
+      "windowed"  the add monoid has a native scatter combiner, the
+                  per-tile dense cell count is bounded, the output is
+                  dense enough that one cell scan beats the ESC sort
+                  (``WINDOWED_MAX_CELLS_PER_FLOP``), and the backend
+                  can accumulate densely: ``scatter`` directly, or
+                  ``dot`` (TPU) whenever a 512-wide B column panel fits
+                  ``WINDOWED_MAX_PANEL_CELLS`` — the 2D windows bound
+                  the stage operand, so TPU mid-scale products now
+                  route here instead of falling through to scan;
       "scan"      everything else — output-bounded ESC (the general
                   fallback; exact for every semiring).
 
     Forced override: ``spgemm_auto(tier=...)`` or env
-    ``COMBBLAS_SPGEMM_TIER``.
+    ``COMBBLAS_SPGEMM_TIER``; backend via argument, env
+    ``COMBBLAS_SPGEMM_BACKEND``, or the platform default.
     """
     from ..ops.spgemm import scatter_combine_for
 
+    backend = resolve_spgemm_backend(backend)
     max_dim = max(A.local_rows, A.local_cols, B.local_cols)
+    cells = A.local_rows * B.local_cols
     if max_dim <= MXU_MAX_TILE_DIM and sr.name in _PALLAS_KINDS:
-        return "mxu"  # no symbolic pass / readback needed for this gate
+        # no symbolic pass needed for this gate — but the unique-entry
+        # precondition of the densifying mxu tier must hold, else fall
+        # back to a duplicate-absorbing rung (ISSUE 5 guard)
+        if assume_unique or not (
+            coo_has_duplicates(A)
+            or (B is not A and coo_has_duplicates(B))
+        ):
+            return "mxu"
+        if obs.ENABLED:
+            obs.count("spgemm.auto.dedup_fallback", sr=sr.name)
+        flops_total = float(
+            np.asarray(host_value(summa_stage_flops(A, B, padded=False)))
+            .astype(np.float64).sum()
+        )
+        return choose_tier_from_counts(
+            sr, max_dim, cells, A.grid.pr, flops_total, backend,
+            k_dim=B.local_rows, allow_mxu=False, n_dim=B.local_cols,
+        )
     # evaluate every STATIC windowed precondition before paying the
     # symbolic pass: the device pass ends in a host readback, which on
     # the target chip permanently degrades later launches (bench.py
     # module docstring) — never spend it when windowed is structurally
-    # ineligible (e.g. the TPU 'dot' backend, generic monoids)
-    if backend is None:
-        backend = "dot" if jax.default_backend() == "tpu" else "scatter"
-    cells = A.local_rows * B.local_cols
+    # ineligible (generic monoids, oversized tiles, infeasible panels)
     if (
-        backend != "scatter"
-        or scatter_combine_for(sr) is None
+        scatter_combine_for(sr) is None
         or cells > WINDOWED_MAX_TILE_CELLS
+        or (
+            backend == "dot"
+            and (
+                sr.name not in _PALLAS_KINDS
+                or not dot_panel_feasible(B.local_rows, B.local_cols)
+            )
+        )
     ):
         return "scan"
     flops_total = float(
@@ -1510,6 +2234,8 @@ def choose_spgemm_tier(
         A.grid.pr,
         flops_total,
         backend,
+        k_dim=B.local_rows,
+        n_dim=B.local_cols,
     )
 
 
@@ -1525,7 +2251,10 @@ def spgemm_auto(
     interpret: bool = False,
     tier: str | None = None,
     block_rows: int | None = None,
+    block_cols: int | None = None,
     backend: str | None = None,
+    oracle: bool = False,
+    assume_unique: bool = False,
 ) -> SpParMat:
     """Auto-tiered sparse-output SpGEMM: route (shape, density, semiring)
     through the fastest applicable kernel instead of defaulting to ESC.
@@ -1534,37 +2263,55 @@ def spgemm_auto(
 
       "mxu"      full-dense MXU stage products + one windowed extraction
                  (small tiles, dense-kernel semirings);
-      "windowed" dense ROW-BLOCK accumulators (scatter or MXU stage
-                 fold) + symbolically-sized windowed extraction with
-                 empty blocks skipped — the general mid-scale tier that
-                 removes the ESC sort;
+      "windowed" dense WINDOW accumulators (scatter row blocks, or MXU
+                 row-block × col-window 2D stage products) +
+                 symbolically-sized windowed extraction with empty
+                 windows skipped — the general mid-scale tier that
+                 removes the ESC sort, on every backend;
       "scan"/"esc"  output-bounded / classic ESC (general fallback).
 
-    ``tier`` (or env ``COMBBLAS_SPGEMM_TIER``) forces a rung; the chosen
-    tier is recorded as the labeled ``spgemm.auto.tier`` counter, with
-    ``spgemm.windowed.windows_skipped`` / ``spgemm.auto.mask_density``
-    exposing the windowed tier's skip list and symbolic output density.
+    ``tier`` (or env ``COMBBLAS_SPGEMM_TIER``) forces a rung;
+    ``backend`` (or env ``COMBBLAS_SPGEMM_BACKEND``) forces the
+    windowed accumulate backend; ``block_rows``/``block_cols`` (or envs
+    ``COMBBLAS_SPGEMM_BLOCK_ROWS`` / ``COMBBLAS_SPGEMM_BLOCK_COLS``)
+    override the window geometry.  The chosen tier is recorded as the
+    labeled ``spgemm.auto.tier`` counter, with
+    ``spgemm.windowed.windows_skipped`` /
+    ``spgemm.windowed.col_windows_skipped`` /
+    ``spgemm.windowed.window_density`` / ``spgemm.auto.mask_density``
+    exposing the skip lists and symbolic output density.
 
     ``mode`` sets the dense plus_times precision (see ``_mxu_dot``):
     "f32" (exact, slow MXU path), "bf16" (13.3 TFLOP/s — exact for
     bf16-representable values like 0/1 adjacency with counts < 2^24),
     "bf16x3" (split-float, f32-grade error, ~4x faster than f32).
+    ``oracle=True`` lets the dot-backend windowed tier tighten its
+    per-window extraction caps with the bit-packed support oracle.
 
-    PRECONDITION (every DENSIFYING path: the mxu tier and the windowed
-    tier's ``backend="dot"``): input tiles must hold UNIQUE (row, col)
-    entries — ``densify``'s scatter declares ``unique_indices`` and
-    duplicate slots would combine unpredictably.  COO inputs with
-    repeats are handled exactly by the scatter-backend windowed tier
-    and by scan/esc (the expansion + semiring fold absorbs them); dedup
-    on host (``np.unique`` of the key) or via ``SpTuples.compact``
-    before routing to a densifying path.
+    PRECONDITION (mxu tier only): input tiles must hold UNIQUE
+    (row, col) entries — ``densify``'s scatter declares
+    ``unique_indices`` and duplicate slots would combine
+    unpredictably.  The router guards this (``coo_has_duplicates``
+    check + fallback; skip it with ``assume_unique=True`` on compacted
+    inputs).  Every other rung — INCLUDING the windowed tier's ``dot``
+    backend, which densifies with the combining scatter
+    (``densify_combine``) — absorbs duplicate COO entries exactly.
     """
     import os
 
     if tier is None:
         tier = os.environ.get("COMBBLAS_SPGEMM_TIER") or None
+    if block_rows is None:
+        env_br = os.environ.get("COMBBLAS_SPGEMM_BLOCK_ROWS")
+        # "0" means default too (the bench knobs' convention)
+        block_rows = (int(env_br) or None) if env_br else None
+    if block_cols is None:
+        env_bc = os.environ.get("COMBBLAS_SPGEMM_BLOCK_COLS")
+        block_cols = (int(env_bc) or None) if env_bc else None
     if tier is None:
-        tier = choose_spgemm_tier(sr, A, B, backend=backend)
+        tier = choose_spgemm_tier(
+            sr, A, B, backend=backend, assume_unique=assume_unique
+        )
     assert tier in ("mxu", "windowed", "scan", "esc"), tier
     if obs.ENABLED:
         obs.count("spgemm.auto.tier", tier=tier, sr=sr.name)
@@ -1578,8 +2325,9 @@ def spgemm_auto(
             )
         if tier == "windowed":
             return spgemm_windowed(
-                sr, A, B, block_rows=block_rows, backend=backend,
-                mode=mode, slack=slack, interpret=interpret,
+                sr, A, B, block_rows=block_rows, block_cols=block_cols,
+                backend=backend, mode=mode, slack=slack,
+                interpret=interpret, oracle=oracle,
             )
         # tier == "mxu": the round-4 whole-tile dense path
         if out_capacity is None:

@@ -155,6 +155,53 @@ def test_disabled_instrumentation_is_free(rng):
     assert obs.registry.empty()  # still nothing recorded
 
 
+def test_windowed_dot_counters_gated(rng):
+    """ISSUE 5 satellite: a forced windowed-dot SpGEMM emits the
+    ``spgemm.auto.tier{tier=windowed}`` counter and the 2D skip
+    counters under obs — and NOTHING when disabled (the zero-cost gate
+    extended to the round-7 counter series)."""
+    from combblas_tpu import PLUS_TIMES
+    from combblas_tpu.parallel.grid import Grid
+    from combblas_tpu.parallel.spgemm import spgemm_auto
+    from combblas_tpu.parallel.spmat import SpParMat
+
+    grid = Grid.make(1, 1)
+    m = 64
+    r = rng.integers(0, m, 300).astype(np.int64)
+    c = rng.integers(0, m, 300).astype(np.int64)
+    A = SpParMat.from_global_coo(
+        grid, r, c, np.ones(300, np.float32), m, m
+    )
+    assert not obs.ENABLED
+    spgemm_auto(
+        PLUS_TIMES, A, A, tier="windowed", backend="dot",
+        block_rows=32, block_cols=32,
+    )
+    assert obs.registry.empty()  # disabled: zero bookkeeping
+    obs.enable(install_hooks=False)
+    try:
+        obs.reset()
+        spgemm_auto(
+            PLUS_TIMES, A, A, tier="windowed", backend="dot",
+            block_rows=32, block_cols=32,
+        )
+        assert obs.registry.get_counter(
+            "spgemm.auto.tier", tier="windowed", sr="plus_times"
+        ) == 1
+        assert obs.registry.get_gauge(
+            "spgemm.windowed.col_windows"
+        ) == 2
+        assert obs.registry.get_counter(
+            "spgemm.windowed.col_windows_skipped"
+        ) >= 0
+        assert obs.registry.get_gauge(
+            "spgemm.windowed.panel_cells"
+        ) == 512 * 512
+    finally:
+        obs.disable()
+        obs.reset()
+
+
 # --- JSONL round-trip + multihost merge -------------------------------------
 
 

@@ -17,23 +17,37 @@ from combblas_tpu.ops.compressed import CSR, CSC
 from combblas_tpu.ops.spgemm import (
     combine_hilo,
     dense_support_nnz,
+    densify_combine,
     pack_support_bits,
     popcount_pair_counts,
     scatter_combine_for,
     spgemm_support_bits,
+    support_window_counts,
 )
 from combblas_tpu.ops.tuples import SpTuples
 from combblas_tpu.parallel.grid import Grid
 from combblas_tpu.parallel.spgemm import (
+    WINDOWED_MAX_COL_WINDOWS,
+    WINDOWED_MAX_PANEL_CELLS,
+    _pad128,
+    choose_spgemm_tier,
     choose_tier_from_counts,
+    default_block_cols,
     default_block_rows,
+    dot_panel_feasible,
+    panel_cap_from_bnnz,
     spgemm,
     spgemm_auto,
     spgemm_windowed,
     summa_rowblock_flops,
     summa_rowblock_flops_host,
     summa_spgemm_windowed,
+    summa_window_bnnz,
+    summa_window_bnnz_host,
+    summa_window_flops_host,
+    summa_window_flops_pair,
     windowed_plan,
+    windowed_plan_2d,
 )
 from combblas_tpu.parallel.spmat import SpParMat
 from combblas_tpu.semiring import Semiring
@@ -187,10 +201,31 @@ def test_tier_gate_rules():
     assert choose_tier_from_counts(
         PLUS_TIMES, 1 << 20, 1 << 33, 1, 1e3, "scatter"
     ) == "scan"
-    # dot backend has no windowed formulation (MXU path handles it)
+    # ISSUE 5: the dot backend now has the 2D B-column-windowed
+    # formulation — mid-scale tiles above the mxu envelope route to
+    # windowed on TPU too (this exact case returned "scan" before)
     assert choose_tier_from_counts(
-        PLUS_TIMES, 1 << 16, 1 << 32, 1, 1e9, "dot"
+        PLUS_TIMES, 1 << 16, 1 << 32, 1, 1e9, "dot", k_dim=1 << 16
+    ) == "windowed"
+    # ...but not when even a minimum 512-wide B panel would exceed the
+    # stage-operand envelope
+    assert choose_tier_from_counts(
+        PLUS_TIMES, 1 << 20, 1 << 33, 1, 1e9, "dot", k_dim=1 << 20
     ) == "scan"
+    # tropical semirings ride the same dot rung (Pallas dense kernel)
+    assert choose_tier_from_counts(
+        MIN_PLUS, 1 << 16, 1 << 32, 1, 1e9, "dot", k_dim=1 << 16
+    ) == "windowed"
+    # generic monoid cannot densify-combine → scan even on dot
+    assert choose_tier_from_counts(
+        generic, 1 << 16, 1 << 32, 1, 1e9, "dot", k_dim=1 << 16
+    ) == "scan"
+    # allow_mxu=False (the duplicate-entry fallback) re-evaluates the
+    # rest of the ladder
+    assert choose_tier_from_counts(
+        PLUS_TIMES, 4096, 4096 * 4096, 1, 1e7, "scatter",
+        allow_mxu=False,
+    ) == "windowed"
 
 
 def test_router_records_obs_counters(rng):
@@ -353,3 +388,282 @@ def test_default_block_rows_bounds():
     assert 1 <= br <= 1 << 16
     assert -(-(1 << 16) // br) <= 33  # ~WINDOWED_MAX_BLOCKS programs
     assert default_block_rows(5, 7) >= 5  # tiny tiles: one block
+
+
+# --- 2D B-column-windowed dot backend (ISSUE 5 tentpole) --------------------
+
+
+@pytest.mark.parametrize("srname", ["plus_times", "min_plus", "max_min"])
+@pytest.mark.parametrize("p", [1, 2])
+def test_windowed_dot_2d_matches_esc_across_semirings(rng, srname, p):
+    """Forced dot-backend 2D windowed == ESC golden across semirings,
+    DUPLICATE-ENTRY COO inputs included: ``densify_combine`` folds
+    repeats with the semiring combiner, so the dot backend no longer
+    carries the mxu tier's unique-entries precondition.  p=1 exercises
+    the per-block local fast path, p=2 the fused shard_map kernel."""
+    sr = {"plus_times": PLUS_TIMES, "min_plus": MIN_PLUS,
+          "max_min": MAX_MIN}[srname]
+    grid = Grid.make(p, p)
+    m, k, n = 64, 48, 80
+    ra, ca, va = coo(rng, m, k, 500, dup_frac=0.2)
+    rb, cb, vb = coo(rng, k, n, 600, dup_frac=0.2)
+    A = SpParMat.from_global_coo(grid, ra, ca, va, m, k)
+    B = SpParMat.from_global_coo(grid, rb, cb, vb, k, n)
+    C_esc = spgemm(sr, A, B)
+    C_win = spgemm_auto(
+        sr, A, B, tier="windowed", backend="dot",
+        block_rows=16, block_cols=32, interpret=True,
+    )
+    np.testing.assert_allclose(
+        dense_of(C_win), dense_of(C_esc), rtol=1e-5, atol=1e-6
+    )
+    assert host_nnz(C_win) == host_nnz(C_esc)
+
+
+def test_windowed_dot_2d_empty_windows_skipped(rng):
+    """A confined to rows [0, 8), B confined to cols [0, 16): every 2D
+    window except (0, 0) is symbolically empty — the plan must skip
+    them (never densified, never matmul'd, never scanned) and the
+    result still matches ESC."""
+    grid = Grid.make(1, 1)
+    m = 64
+    ra = rng.integers(0, 8, 120).astype(np.int64)
+    ca = rng.integers(0, m, 120).astype(np.int64)
+    A = SpParMat.from_global_coo(
+        grid, ra, ca, np.ones(120, np.float32), m, m
+    )
+    rb = rng.integers(0, m, 200).astype(np.int64)
+    cb = rng.integers(0, 16, 200).astype(np.int64)
+    B = SpParMat.from_global_coo(
+        grid, rb, cb, np.ones(200, np.float32), m, m
+    )
+    pair = np.asarray(
+        jax.device_get(summa_window_flops_pair(A, B, 8, 16, chunk_w=8))
+    )
+    fc, oc, skip = windowed_plan_2d(pair[0], pair[1], 8, 16, m, m)
+    assert not skip[0][0]
+    assert all(
+        skip[g][h]
+        for g in range(8) for h in range(4) if (g, h) != (0, 0)
+    ), skip
+    panel_cap = panel_cap_from_bnnz(
+        jax.device_get(summa_window_bnnz(B, 16)), int(B.capacity)
+    )
+    C_win, overflow = summa_spgemm_windowed(
+        PLUS_TIMES, A, B, block_rows=8, flop_caps=fc, out_caps=oc,
+        skip=skip, backend="dot", block_cols=16, panel_cap=panel_cap,
+    )
+    assert int(overflow) <= 0
+    C_esc = spgemm(PLUS_TIMES, A, B)
+    np.testing.assert_allclose(
+        dense_of(C_win), dense_of(C_esc), rtol=1e-5, atol=1e-6
+    )
+
+
+def test_window_flops_host_matches_device_2d(rng):
+    """Host==device agreement of the 2D symbolic plan inputs: the
+    per-(row block, col window) flop pair and the per-window B nnz."""
+    grid = Grid.make(2, 2)
+    m, k, n = 64, 48, 80
+    ra, ca, va = coo(rng, m, k, 400, dup_frac=0.1)
+    rb, cb, vb = coo(rng, k, n, 500, dup_frac=0.1)
+    A = SpParMat.from_global_coo(grid, ra, ca, va, m, k)
+    B = SpParMat.from_global_coo(grid, rb, cb, vb, k, n)
+    dev = np.asarray(
+        jax.device_get(summa_window_flops_pair(A, B, 8, 16, chunk_w=8))
+    )
+    host_pad = summa_window_flops_host(
+        grid, ra, ca, rb, cb, m, k, n, 8, 16, chunk_w=8
+    )
+    host_true = summa_window_flops_host(
+        grid, ra, ca, rb, cb, m, k, n, 8, 16, chunk_w=0
+    )
+    np.testing.assert_array_equal(
+        dev[0].astype(np.int64), host_pad.astype(np.int64)
+    )
+    np.testing.assert_array_equal(
+        dev[1].astype(np.int64), host_true.astype(np.int64)
+    )
+    bnnz_dev = np.asarray(jax.device_get(summa_window_bnnz(B, 16)))
+    bnnz_host = summa_window_bnnz_host(grid, rb, cb, k, n, 16)
+    np.testing.assert_array_equal(
+        bnnz_dev.astype(np.int64), bnnz_host.astype(np.int64)
+    )
+
+
+def test_densify_combine_absorbs_duplicates(rng):
+    """densify_combine == dedup-then-densify under each combiner."""
+    m, n = 20, 30
+    r = rng.integers(0, m, 80).astype(np.int32)
+    c = rng.integers(0, n, 80).astype(np.int32)
+    v = (rng.random(80) + 0.5).astype(np.float32)
+    r = np.concatenate([r, r[:30]])
+    c = np.concatenate([c, c[:30]])
+    v = np.concatenate([v, (rng.random(30) + 0.5).astype(np.float32)])
+    t = SpTuples.from_coo(r, c, v, m, n, capacity=128)
+    for sr, fold, init in (
+        (PLUS_TIMES, np.add, 0.0),
+        (MIN_PLUS, np.minimum, np.inf),
+        (MAX_MIN, np.maximum, -np.inf),
+    ):
+        ref = np.full((32, 32), init, np.float32)
+        for ri, ci, vi in zip(r, c, v):
+            ref[ri, ci] = fold(ref[ri, ci], vi)
+        got = np.asarray(jax.device_get(densify_combine(sr, t, 32, 32)))
+        np.testing.assert_allclose(got, ref, rtol=1e-6)
+
+
+def test_mxu_unique_precondition_guard(rng):
+    """ISSUE 5 satellite: the router detects duplicate-entry tiles and
+    demotes mxu to a duplicate-absorbing rung instead of silently
+    producing wrong results; ``assume_unique`` skips the check."""
+    grid = Grid.make(1, 1)
+    m = 48
+    ra, ca, va = coo(rng, m, m, 300, dup_frac=0.2)  # repeats guaranteed
+    A = SpParMat.from_global_coo(grid, ra, ca, va, m, m)
+    tier = choose_spgemm_tier(PLUS_TIMES, A, A, backend="scatter")
+    assert tier in ("windowed", "scan")
+    assert choose_spgemm_tier(
+        PLUS_TIMES, A, A, backend="scatter", assume_unique=True
+    ) == "mxu"
+    # unique input still routes mxu
+    key, idx = np.unique(ra * m + ca, return_index=True)
+    Au = SpParMat.from_global_coo(
+        grid, ra[idx], ca[idx], va[idx], m, m
+    )
+    assert choose_spgemm_tier(
+        PLUS_TIMES, Au, Au, backend="scatter"
+    ) == "mxu"
+    # the auto-routed product on the duplicate input stays EXACT (the
+    # fallback rung absorbs repeats), and the demotion is observable
+    obs.enable(install_hooks=False)
+    try:
+        obs.reset()
+        C = spgemm_auto(PLUS_TIMES, A, A, backend="scatter")
+        assert obs.registry.get_counter(
+            "spgemm.auto.dedup_fallback", sr="plus_times"
+        ) == 1
+        assert obs.registry.get_counter(
+            "spgemm.auto.tier", tier="mxu", sr="plus_times"
+        ) == 0
+    finally:
+        obs.disable()
+        obs.reset()
+    ref = spgemm(PLUS_TIMES, A, A)
+    np.testing.assert_allclose(
+        dense_of(C), dense_of(ref), rtol=1e-5, atol=1e-6
+    )
+
+
+def test_router_routes_midscale_to_windowed_dot(rng, monkeypatch):
+    """ISSUE 5 acceptance: a product whose B tile exceeds the mxu
+    envelope auto-selects windowed with backend='dot' (it fell through
+    to scan before), and the 2D run bounds the stage operand by the
+    column window (panel_cells gauge ≤ envelope) while agreeing with
+    the ESC golden."""
+    import combblas_tpu.parallel.spgemm as psp
+
+    # shrink the mxu envelope so a 96-dim tile is "mid-scale" for the
+    # test (the real envelope needs scale-14 tiles — benchmark turf)
+    monkeypatch.setattr(psp, "MXU_MAX_TILE_DIM", 32)
+    grid = Grid.make(1, 1)
+    m = 96
+    ra, ca, va = coo(rng, m, m, 2000)
+    A = SpParMat.from_global_coo(grid, ra, ca, va, m, m)
+    assert psp.choose_spgemm_tier(
+        PLUS_TIMES, A, A, backend="dot"
+    ) == "windowed"
+    obs.enable(install_hooks=False)
+    try:
+        obs.reset()
+        C = spgemm_auto(
+            PLUS_TIMES, A, A, backend="dot", block_rows=32,
+            block_cols=32,
+        )
+        assert obs.registry.get_counter(
+            "spgemm.auto.tier", tier="windowed", sr="plus_times"
+        ) == 1
+        panel_cells = obs.registry.get_gauge(
+            "spgemm.windowed.panel_cells"
+        )
+        assert panel_cells == _pad128(m) * _pad128(32)
+        assert panel_cells <= WINDOWED_MAX_PANEL_CELLS
+        assert obs.registry.get_gauge(
+            "spgemm.windowed.col_windows"
+        ) == 3
+        assert obs.registry.get_counter(
+            "spgemm.windowed.col_windows_skipped"
+        ) >= 0
+        assert obs.registry.get_gauge(
+            "spgemm.windowed.window_density"
+        ) > 0
+    finally:
+        obs.disable()
+        obs.reset()
+    ref = spgemm(PLUS_TIMES, A, A)
+    np.testing.assert_allclose(
+        dense_of(C), dense_of(ref), rtol=1e-5, atol=1e-6
+    )
+
+
+def test_windowed_dot_panel_envelope():
+    """The stage-operand memory bound: default_block_cols keeps one
+    dense B panel within WINDOWED_MAX_PANEL_CELLS and the unrolled
+    window count bounded; at mid scale the panel is a strict fraction
+    of B's full dense tile width (the quantity that used to force the
+    router to scan on TPU)."""
+    for lrb, lcb in [(1 << 16, 1 << 16), (16384, 16384), (8192, 65536)]:
+        bc = default_block_cols(lrb, lcb)
+        pk, pwin = _pad128(lrb), _pad128(bc)
+        assert 1 <= bc <= max(lcb, 1)
+        assert -(-lcb // bc) <= WINDOWED_MAX_COL_WINDOWS
+        if pk * 512 <= WINDOWED_MAX_PANEL_CELLS:
+            assert pk * pwin <= WINDOWED_MAX_PANEL_CELLS, (lrb, lcb)
+    # scale-16 square tile: the panel is ≥16x narrower than dense B
+    bc = default_block_cols(1 << 16, 1 << 16)
+    assert _pad128(bc) * 16 <= _pad128(1 << 16)
+    # tiny tiles degenerate to one window
+    assert default_block_cols(64, 80) == 80
+    # extreme region pad(k)·lcB > 32·PANEL: the window-count floor
+    # would exceed the envelope, so the router gates it to scan (only
+    # forced calls may trade memory for program size there)
+    assert not dot_panel_feasible(1 << 17, 1 << 16)
+    assert dot_panel_feasible(1 << 17)  # a 512-wide window alone fits
+    assert choose_tier_from_counts(
+        PLUS_TIMES, 1 << 17, (1 << 17) * (1 << 16), 1, 1e12, "dot",
+        k_dim=1 << 17, n_dim=1 << 16,
+    ) == "scan"
+
+
+def test_support_oracle_window_counts_and_seeding(rng):
+    """``support_window_counts`` returns the exact per-window output
+    nnz, and ``spgemm_windowed(oracle=True)`` (dot backend) stays exact
+    with the tightened caps."""
+    da = (rng.random((64, 48)) < 0.15).astype(np.float32)
+    db = (rng.random((48, 64)) < 0.15).astype(np.float32)
+    a = SpTuples.from_dense(da, capacity=600)
+    b = SpTuples.from_dense(db, capacity=600)
+    bits, _ = spgemm_support_bits(a, b, row_block=16)
+    cnt = np.asarray(
+        jax.device_get(support_window_counts(bits, 16, 32, 64, 64))
+    )
+    P = (da @ db) > 0
+    for g in range(4):
+        for h in range(2):
+            want = int(
+                P[g * 16:(g + 1) * 16, h * 32:(h + 1) * 32].sum()
+            )
+            assert cnt[g, h] == want, (g, h)
+    grid = Grid.make(1, 1)
+    m = 64
+    ra, ca, va = coo(rng, m, m, 700)
+    A = SpParMat.from_global_coo(grid, ra, ca, va, m, m)
+    ref = spgemm(PLUS_TIMES, A, A)
+    C = spgemm_windowed(
+        PLUS_TIMES, A, A, block_rows=32, block_cols=32, backend="dot",
+        oracle=True,
+    )
+    np.testing.assert_allclose(
+        dense_of(C), dense_of(ref), rtol=1e-5, atol=1e-6
+    )
+    assert host_nnz(C) == host_nnz(ref)
