@@ -27,6 +27,15 @@ BENCH_OBS=1 attaches the structured telemetry sidecar through
 latency histograms, plan-cache + trace counters land in the JSONL);
 ``bench.py`` invokes this file under ``BENCH_SERVE=1`` with the sidecar
 on by default.
+
+BENCH_SERVE_CHAOS=1 runs the CHAOS scenario instead (ISSUE 6): the same
+mixed stream through the threaded server under a seeded
+``BENCH_SERVE_CHAOS_RATE`` (default 5%) execute-fault schedule plus a
+``BENCH_SERVE_CHAOS_SWAPS``-deep (default 3) graph hot-swap storm, and
+gates on: availability >= 95% of well-formed requests, ZERO stranded
+futures, zero post-swap retraces (same-shape versions: the plan cache
+must survive every swap), and all swaps applied. Reports availability
+%, ok-request p50/p99 latency, and per-swap latency.
 """
 
 from __future__ import annotations
@@ -61,19 +70,16 @@ def _percentile(xs: list[float], q: float) -> float:
     return xs[i]
 
 
-def run(scale: int = SCALE, edgefactor: int = EDGEFACTOR,
-        width: int = WIDTH, nqueries: int = NQUERIES,
-        grid_shape=(2, 4), kinds=("bfs", "pagerank")) -> dict:
+def _setup(scale, edgefactor, width, nqueries, grid_shape, kinds,
+           widths):
+    """Shared graph/stream/warmup setup: the chaos scenario must
+    measure the SAME engine, stream, and warm plans the baseline
+    scenario does."""
     import numpy as np
 
-    from combblas_tpu import obs
     from combblas_tpu.parallel.grid import Grid
-    from combblas_tpu.serve import (
-        BackpressureError, GraphEngine, ServeConfig,
-    )
+    from combblas_tpu.serve import GraphEngine
     from combblas_tpu.utils.rmat import rmat_symmetric_coo_host
-
-    sidecar = obs.enable_sidecar("serve")
 
     n = 1 << scale
     rows, cols = rmat_symmetric_coo_host(42, scale, edgefactor)
@@ -94,12 +100,28 @@ def run(scale: int = SCALE, edgefactor: int = EDGEFACTOR,
         (kinds[i % len(kinds)], int(r)) for i, r in enumerate(roots)
     ]
 
-    # plans for every bucket the server may flush under, plus width-1
-    # for the baseline — after this, ZERO traces is the contract
-    widths = tuple(sorted({1, width}))
     t0 = time.perf_counter()
     engine.warmup(kinds=kinds, widths=widths)
     warmup_s = time.perf_counter() - t0
+    return engine, rows, cols, roots, stream, load_s, warmup_s
+
+
+def run(scale: int = SCALE, edgefactor: int = EDGEFACTOR,
+        width: int = WIDTH, nqueries: int = NQUERIES,
+        grid_shape=(2, 4), kinds=("bfs", "pagerank")) -> dict:
+    import numpy as np
+
+    from combblas_tpu import obs
+    from combblas_tpu.serve import BackpressureError, ServeConfig
+
+    sidecar = obs.enable_sidecar("serve")
+
+    # plans for every bucket the server may flush under, plus width-1
+    # for the baseline — after this, ZERO traces is the contract
+    widths = tuple(sorted({1, width}))
+    engine, rows, _cols, roots, stream, load_s, warmup_s = _setup(
+        scale, edgefactor, width, nqueries, grid_shape, kinds, widths,
+    )
     mark = engine.trace_mark()
 
     # -- baseline: one warm call per query --------------------------------
@@ -189,8 +211,146 @@ def run(scale: int = SCALE, edgefactor: int = EDGEFACTOR,
     return out
 
 
+def run_chaos(scale: int = SCALE, edgefactor: int = EDGEFACTOR,
+              width: int = WIDTH, nqueries: int | None = None,
+              grid_shape=(2, 4), kinds=("bfs", "pagerank")) -> dict:
+    """Availability under injected faults + a hot-swap storm (the
+    resilience acceptance scenario — see module docstring)."""
+    from concurrent.futures import Future, wait
+
+    from combblas_tpu import obs
+    from combblas_tpu.serve import BackpressureError, ServeConfig
+
+    sidecar = obs.enable_sidecar("serve-chaos")
+    rate = float(os.environ.get("BENCH_SERVE_CHAOS_RATE", "0.05"))
+    # default seed 11 fires its first 5% fault on the 4th execute call:
+    # even a short, well-coalesced stream provably exercises recovery
+    seed = int(os.environ.get("BENCH_SERVE_CHAOS_SEED", "11"))
+    nswaps = int(os.environ.get("BENCH_SERVE_CHAOS_SWAPS", "3"))
+    nqueries = (
+        int(os.environ.get("BENCH_SERVE_QUERIES", "400"))
+        if nqueries is None else nqueries
+    )
+
+    widths = tuple(sorted({1, 2, 4, 8, width}))
+    engine, rows, cols, _roots, stream, _load_s, _warmup_s = _setup(
+        scale, edgefactor, width, nqueries, grid_shape, kinds, widths,
+    )
+    # the swap storm's versions: SAME COO, so operand shapes match and
+    # the zero-post-swap-retrace gate is a real plan-cache assertion
+    t0 = time.perf_counter()
+    versions = [engine.build_version(rows, cols) for _ in range(nswaps)]
+    build_s = time.perf_counter() - t0
+    mark = engine.trace_mark()
+
+    cfg = ServeConfig(
+        lane_widths=widths, max_queue=max(4 * width, nqueries),
+        max_wait_s=0.005,
+    )
+    lat_of: dict = {}  # future -> completion latency (ok OR failed)
+
+    def _stamp(fut, ts):
+        fut.add_done_callback(
+            lambda f: lat_of.__setitem__(f, time.monotonic() - ts)
+        )
+
+    swap_s: list[float] = []
+    swap_at = {
+        (k + 1) * nqueries // (nswaps + 1): k for k in range(nswaps)
+    }
+    t0 = time.perf_counter()
+    futs = []
+    with engine.serve(cfg) as srv:
+        srv.faults.rate("engine.execute", rate, seed=seed)
+        for i, (kind, root) in enumerate(stream):
+            try:
+                f = srv.submit(kind, root)
+                _stamp(f, time.monotonic())
+            except BackpressureError as e:
+                # breaker fast-fail / queue-full under high chaos
+                # rates: unavailability is DATA here, not a crash
+                f = Future()
+                f.set_exception(e)
+            futs.append(f)
+            k = swap_at.get(i)
+            if k is not None:  # mid-stream, under live load
+                swap_s.append(srv.swap_graph(versions[k])["swap_s"])
+        wait(futs, timeout=600)  # failures are data; stranded counted
+        stats = srv.stats()
+        fault_stats = srv.faults.stats()
+    wall_s = time.perf_counter() - t0
+
+    stranded = sum(1 for f in futs if not f.done())
+    ok = sum(
+        1 for f in futs if f.done() and f.exception(timeout=0) is None
+    )
+    availability = ok / nqueries
+    retraces = engine.retraces_since(mark)
+    lat = [lat_of[f] for f in futs if f in lat_of]
+    ok_lat = [
+        lat_of[f] for f in futs
+        if f in lat_of and f.done() and f.exception(timeout=0) is None
+    ]
+    per_kind = stats["per_kind"]
+
+    out = {
+        "metric": "serve_chaos_availability",
+        "unit": "fraction_ok",
+        "value": round(availability, 4),
+        "availability_pct": round(100 * availability, 2),
+        "ok": bool(
+            availability >= 0.95
+            and stranded == 0
+            and retraces == 0
+            and len(swap_s) == nswaps
+        ),
+        "nqueries": nqueries,
+        "completed_ok": ok,
+        "stranded": stranded,
+        "fault_rate": rate,
+        "fault_seed": seed,
+        "faults_injected": fault_stats["fired"].get("engine.execute", 0),
+        "retried": {
+            k: per_kind[k]["retried"] for k in per_kind
+        },
+        "poisoned": {
+            k: per_kind[k]["poisoned"] for k in per_kind
+        },
+        "breaker_opened": {
+            k: per_kind[k].get("breaker", {}).get("opened_total", 0)
+            for k in per_kind
+        },
+        "p50_ms": round(1e3 * _percentile(lat, 0.50), 2) if lat else None,
+        "p99_ms": round(1e3 * _percentile(lat, 0.99), 2) if lat else None,
+        "p99_ok_ms": (
+            round(1e3 * _percentile(ok_lat, 0.99), 2) if ok_lat else None
+        ),
+        "swaps": len(swap_s),
+        "swap_latency_ms": [round(1e3 * s, 3) for s in swap_s],
+        "swap_build_s": round(build_s, 2),
+        "retraces_after_swaps": retraces,
+        "qps_under_chaos": round(nqueries / wall_s, 2),
+        "width": width,
+        "scale": scale,
+        "grid": list(grid_shape),
+        "kinds": list(kinds),
+        "batches": stats["batches"],
+        "graph_version": stats["graph_version"],
+    }
+    obs.gauge("serve.bench.chaos_availability", availability)
+    if sidecar:
+        try:
+            out["obs_jsonl"] = obs.dump_jsonl()
+        except Exception as e:  # telemetry must never fail the bench
+            out["obs_error"] = str(e)
+    return out
+
+
 def main():
-    out = run()
+    if os.environ.get("BENCH_SERVE_CHAOS") == "1":
+        out = run_chaos()
+    else:
+        out = run()
     print(json.dumps(out), flush=True)
 
 

@@ -68,6 +68,54 @@ name                                       kind     meaning
                                                     (outside the oracle
                                                     envelope)
 =========================================  =======  =====================
+
+Serve resilience series (round 8 — fault injection, poisoned-batch
+isolation, circuit breakers, graph hot-swap; docs/serving.md
+"Resilience"):
+
+==============================  =========  ==============================
+name                            kind       meaning
+==============================  =========  ==============================
+``serve.faults.injected``       counter    faults fired by the injection
+                                           framework; labels ``point``
+                                           (serve/faults.py
+                                           FAULT_POINTS) and ``rule``
+                                           (script/rate/when)
+``serve.retry.requests``        counter    requests re-executed by the
+                                           poisoned-batch bisection
+                                           retrier (labels: ``kind``)
+``serve.poison.isolated``       counter    requests failed after
+                                           exhausting the retry budget
+                                           (the isolated poison, or
+                                           every rider of a genuinely
+                                           dead engine); labels ``kind``
+``serve.breaker.state``         gauge      per-kind breaker state:
+                                           0 closed / 1 half-open /
+                                           2 open (labels: ``kind``)
+``serve.breaker.opened``        counter    breaker open transitions
+                                           (labels: ``kind``)
+``serve.breaker.fast_fail``     counter    submits rejected by an open
+                                           breaker (labels: ``kind``)
+``serve.worker.errors``         counter    worker-loop (scheduler-bug)
+                                           errors, labeled by
+                                           ``exc_type``
+``serve.worker.backoff_s``      gauge      current worker error backoff
+                                           (exponential, capped, reset
+                                           on success)
+``serve.swap.latency_s``        histogram  atomic graph-version swap
+                                           latency (lock wait + pointer
+                                           flip)
+``serve.swap.build_s``          histogram  off-lock build time of the
+                                           next GraphVersion
+``serve.swap.count``            counter    completed hot-swaps
+``serve.graph.version``         gauge      currently-served graph
+                                           version id
+==============================  =========  ==============================
+
+``serve.requests{status=timeout}`` now also counts EXECUTION-time
+deadline drops (a request already expired when its batch reached the
+device is settled before occupying a lane), not just queue-sweep
+expiries.
 """
 
 from __future__ import annotations

@@ -17,7 +17,15 @@ batches". Four layers (docs/serving.md has the full architecture):
    reject-with-retry-after admission control, per-kind flush deadlines,
    per-request timeouts, and per-request error isolation.
 4. **api** (`api.py`) — ``Server``: ``submit()/submit_many()/stats()``
-   plus the single worker thread that owns the execution stream.
+   plus the single worker thread that owns the execution stream, the
+   poisoned-batch bisection retrier, execution-time deadline
+   enforcement, ``health()``, and ``swap_graph()`` (atomic graph-
+   version hot-swap, plan cache surviving).
+5. **faults** (`faults.py`) — deterministic fault injection: named
+   failure points threaded through the worker path, armed with
+   scripted/seeded/predicate rules so every recovery path (bisection,
+   per-kind circuit breakers, worker backoff, swap rollback) is
+   testable and chaos-benchable.
 
 Everything is wired into ``combblas_tpu.obs`` (queue-depth gauge,
 occupancy/padding-waste/latency histograms, plan-cache and
@@ -26,12 +34,21 @@ against the one-call-per-query baseline.
 """
 
 from .batcher import Request, assemble, bucket_width, scatter
-from .engine import KINDS, GraphEngine
-from .scheduler import BackpressureError, Scheduler, ServeConfig
+from .engine import KINDS, GraphEngine, GraphVersion
+from .faults import FAULT_POINTS, FaultInjector, InjectedFault
+from .scheduler import (
+    BackpressureError,
+    CircuitBreaker,
+    CircuitBreakerOpen,
+    Scheduler,
+    ServeConfig,
+)
 from .api import Server
 
 __all__ = [
-    "GraphEngine", "Server", "ServeConfig", "Scheduler",
-    "BackpressureError", "Request", "KINDS",
+    "GraphEngine", "GraphVersion", "Server", "ServeConfig", "Scheduler",
+    "BackpressureError", "CircuitBreaker", "CircuitBreakerOpen",
+    "FaultInjector", "InjectedFault", "FAULT_POINTS",
+    "Request", "KINDS",
     "bucket_width", "assemble", "scatter",
 ]

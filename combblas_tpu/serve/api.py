@@ -8,6 +8,17 @@ scattering lane results back to futures. ``submit_many`` is the bulk
 front door; ``stats()`` surfaces queue depth, batch occupancy, plan
 cache and trace counts without needing obs enabled.
 
+The worker path is the resilience ladder (docs/serving.md
+"Resilience"): expired requests are dropped before they occupy a lane,
+a failed batch is bisected and retried under a bounded per-request
+budget (one poison request fails alone, lane-mates survive),
+top-level batch outcomes feed per-kind circuit breakers, the loop
+backs off exponentially on scheduler-level errors, and
+``swap_graph()`` atomically replaces the served graph version under
+load with the plan cache surviving. ``health()`` is the pollable
+liveness surface; ``Server.faults`` the deterministic fault-injection
+hook every recovery path is tested through.
+
 Usage::
 
     engine = GraphEngine.from_coo(grid, rows, cols, n)
@@ -27,7 +38,8 @@ from concurrent.futures import Future
 
 from .. import obs
 from . import batcher
-from .scheduler import BackpressureError, Scheduler, ServeConfig
+from .faults import FaultInjector
+from .scheduler import BackpressureError, Scheduler, ServeConfig, _bump
 
 
 class Server:
@@ -39,14 +51,27 @@ class Server:
         self.scheduler = Scheduler(
             self.config, engine.nrows, engine.kinds()
         )
+        # deterministic fault injection (serve/faults.py): unarmed by
+        # default (one attribute read per check); chaos tests and the
+        # chaos bench arm rules on this instance
+        self.faults = FaultInjector()
         self._wake = threading.Condition()
         self._stop = False
         self._worker: threading.Thread | None = None
-        self.batches = 0
+        self.batches = 0  # TOP-LEVEL batches (retries counted apart)
+        self.retry_batches = 0  # bisection-recovery sub-batches
         self.completed = 0
         self.worker_errors = 0
         self.last_worker_error: Exception | None = None
+        self.last_worker_error_at: float | None = None  # time.time()
+        self._backoff_s = self.config.worker_backoff_s
         self._occupancy_sum = 0.0
+        # per-kind execution-side disposition counters (queue-side
+        # twins live on the scheduler); bumped only by the executing
+        # thread, read by stats()
+        self._timeout_exec: dict[str, int] = {}
+        self._poisoned: dict[str, int] = {}
+        self._retried: dict[str, int] = {}
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -118,6 +143,7 @@ class Server:
         when the bounded queue is full (reject + retry-after, never
         unbounded blocking); malformed roots come back as failed
         futures (error isolation — see scheduler.submit)."""
+        self.faults.check("scheduler.admit", kind=kind, root=root)
         fut = self.scheduler.submit(kind, root, timeout_s=timeout_s)
         with self._wake:
             self._wake.notify_all()
@@ -133,21 +159,18 @@ class Server:
         out: list[Future] = []
         for i, r in enumerate(roots):
             try:
+                self.faults.check("scheduler.admit", kind=kind, root=r)
                 out.append(
                     self.scheduler.submit(kind, r, timeout_s=timeout_s)
                 )
             except (BackpressureError, RuntimeError) as e:
-                # backpressure OR a concurrent close(): either way the
-                # caller must still get one future per root — the
+                # backpressure, breaker fast-fail, a concurrent
+                # close(), or an injected admission fault: either way
+                # the caller must still get one future per root — the
                 # admitted prefix's results stay reachable
                 for _ in roots[i:]:
                     f = Future()
-                    f.set_exception(
-                        BackpressureError(
-                            self.scheduler.depth(), e.retry_after_s
-                        )
-                        if isinstance(e, BackpressureError) else e
-                    )
+                    f.set_exception(e)
                     out.append(f)
                 break
         with self._wake:
@@ -156,21 +179,107 @@ class Server:
 
     # -- worker ------------------------------------------------------------
 
+    def _drop_dead(self, reqs, now: float | None = None) -> list:
+        """Deadline enforcement at EXECUTION time: a request that is
+        already settled (client cancel) or already past its deadline is
+        dropped here, before it occupies a device lane — the queue
+        sweep in ``pop_ready`` catches most, but a request can expire
+        between pop and execute (or during a failing batch's bisection
+        retries). Returns the live remainder."""
+        now = time.monotonic() if now is None else now
+        live = []
+        for r in reqs:
+            if r.future.done():
+                continue
+            if r.expired(now):
+                batcher.expire(
+                    r, "expired before execution",
+                    lambda q: _bump(self._timeout_exec, q.kind),
+                )
+            else:
+                live.append(r)
+        return live
+
+    def _run_batch(self, reqs, *, toplevel: bool = True) -> None:
+        """Execute one batch with the full recovery ladder: drop dead
+        requests, run, and on failure hand the survivors to the
+        bisection retrier. Top-level outcomes (not bisection
+        sub-batches) feed the kind's circuit breaker, so one poisoned
+        request cannot open it."""
+        live = self._drop_dead(reqs)
+        if not live:
+            return
+        kind = live[0].kind
+        breaker = self.scheduler.breakers.get(kind)
+        try:
+            self.faults.check("batch.assemble", kind=kind,
+                              width=len(live))
+            sources = batcher.assemble(
+                live, self.config.lane_widths, record=toplevel
+            )
+            if toplevel:
+                # occupancy/batch accounting measures COALESCING, so
+                # retry sub-batches stay out of it (they are visible
+                # as retry_batches / per_kind retried instead)
+                self.batches += 1
+                self._occupancy_sum += len(live) / len(sources)
+            else:
+                self.retry_batches += 1
+            self.faults.check(
+                "engine.execute", kind=kind,
+                roots=tuple(r.root for r in live),
+            )
+            result = self.engine.execute(kind, sources)
+            self.faults.check("batch.scatter", kind=kind)
+            self.completed += batcher.scatter(
+                live, result,
+                on_timeout=lambda r: _bump(self._timeout_exec, r.kind),
+            )
+            if breaker is not None and toplevel:
+                breaker.record_success(time.monotonic(), kind)
+        except Exception as e:  # failure touches THIS batch only
+            if breaker is not None and toplevel:
+                breaker.record_failure(time.monotonic(), kind)
+            self._recover(live, e)
+
+    def _recover(self, reqs, exc: Exception) -> None:
+        """Poisoned-batch isolation: a failed batch is bisected and
+        retried so one poison request fails ALONE instead of taking
+        its lane-mates with it. Each request rides at most
+        ``retry_budget`` failing executions (budget 5 = a full
+        16→8→4→2→1 bisection), then its future fails with the last
+        error — bounded work, no stranded futures."""
+        kind = reqs[0].kind
+        budget = self.config.retry_budget
+        retry = []
+        for r in reqs:
+            r.attempts += 1
+            if r.attempts >= budget:
+                if batcher.settle(r.future, exc=exc):
+                    _bump(self._poisoned, kind)
+                    obs.count("serve.requests", kind=kind,
+                              status="error")
+                    obs.count("serve.poison.isolated", kind=kind)
+            else:
+                retry.append(r)
+        if not retry:
+            return
+        _bump(self._retried, kind, len(retry))
+        obs.count("serve.retry.requests", len(retry), kind=kind)
+        if len(retry) == 1:
+            self._run_batch(retry, toplevel=False)
+            return
+        mid = (len(retry) + 1) // 2
+        self._run_batch(retry[:mid], toplevel=False)
+        self._run_batch(retry[mid:], toplevel=False)
+
     def _execute_batches(self, ready) -> None:
         for reqs in ready:
             # whole-batch guard: these requests are already popped, so
             # ANY failure (assemble, engine, scatter) must settle their
-            # futures — a stranded future blocks its caller forever
-            try:
-                sources = batcher.assemble(
-                    reqs, self.config.lane_widths
-                )
-                self.batches += 1
-                self._occupancy_sum += len(reqs) / len(sources)
-                result = self.engine.execute(reqs[0].kind, sources)
-                self.completed += batcher.scatter(reqs, result)
-            except Exception as e:  # failure fails THIS batch only
-                batcher.fail(reqs, e)
+            # futures (possibly after bisection retries) — a stranded
+            # future blocks its caller forever
+            self._run_batch(reqs)
 
     def pump(self, force: bool = False) -> int:
         """One synchronous scheduling step (the worker's body, callable
@@ -190,18 +299,35 @@ class Server:
             # may already fill a lane bucket — flush-on-full must not
             # wait out the deadline
             try:
-                if self.pump():
+                pumped = self.pump()
+                if self._backoff_s != self.config.worker_backoff_s:
+                    # reset on success — and bring the gauge back down
+                    # with it (a one-time write: steady state is free)
+                    self._backoff_s = self.config.worker_backoff_s
+                    obs.gauge("serve.worker.backoff_s", self._backoff_s)
+                if pumped:
                     continue
             except Exception as e:  # the worker must outlive any one
                 # pump: a dead worker with an open front door would
                 # admit requests whose futures never complete. The
                 # error is RETAINED and printed — an obs counter alone
-                # would vanish with telemetry off (the default)
+                # would vanish with telemetry off (the default). Batch
+                # failures never reach here (the recovery ladder
+                # settles them); this is the scheduler-bug backstop,
+                # so it backs off exponentially (capped, reset on
+                # success) instead of spinning at a fixed 50 ms
                 self.worker_errors += 1
                 self.last_worker_error = e
-                obs.count("serve.worker.errors")
+                self.last_worker_error_at = time.time()
+                obs.count(
+                    "serve.worker.errors", exc_type=type(e).__name__
+                )
+                obs.gauge("serve.worker.backoff_s", self._backoff_s)
                 traceback.print_exc(file=sys.stderr)
-                time.sleep(0.05)
+                time.sleep(self._backoff_s)
+                self._backoff_s = min(
+                    2 * self._backoff_s, self.config.worker_backoff_max_s
+                )
                 continue
             with self._wake:
                 if self._stop:
@@ -226,17 +352,81 @@ class Server:
         # drain happens in close(), after this thread has joined — one
         # executor at a time, and a never-started worker drains too
 
+    # -- graph hot-swap ----------------------------------------------------
+
+    def swap_graph(self, version=None, *, rows=None, cols=None,
+                   weights=None, **build_kw) -> dict:
+        """Atomically replace the served graph while the server keeps
+        running: in-flight batches finish on the OLD version (the swap
+        waits on the engine's execution lock), queued and future
+        requests execute on the new one, and the plan cache survives
+        (same-shape versions: zero retraces). Pass either a prebuilt
+        ``GraphVersion`` (``engine.build_version(...)`` — build it
+        BEFORE calling, off the serving path) or a COO
+        (``rows=``/``cols=``/``weights=``), which is built here, also
+        outside the execution lock. Returns
+        ``{"version", "swap_s", "nnz"}``."""
+        if version is None:
+            if rows is None or cols is None:
+                raise ValueError(
+                    "swap_graph needs a GraphVersion or rows=/cols="
+                )
+            version = self.engine.build_version(
+                rows, cols, weights=weights, **build_kw
+            )
+        self.faults.check("engine.swap", version=version)
+        swap_s = self.engine.swap(version)
+        return {
+            "version": self.engine.version_id,
+            "swap_s": swap_s,
+            "nnz": version.nnz,
+        }
+
     # -- introspection -----------------------------------------------------
+
+    def _last_error(self) -> dict | None:
+        """The retained worker error as {repr, at} (shared by stats()
+        and health())."""
+        if self.last_worker_error is None:
+            return None
+        return {
+            "repr": repr(self.last_worker_error),
+            "at": self.last_worker_error_at,
+        }
 
     def stats(self) -> dict:
         s = self.engine.stats()
+        sch = self.scheduler
+        now = time.monotonic()
+        per_kind = {
+            k: {
+                "rejected": sch.rejected_kind.get(k, 0),
+                "invalid": sch.invalid_kind.get(k, 0),
+                "timeout": (
+                    sch.timeout_kind.get(k, 0)
+                    + self._timeout_exec.get(k, 0)
+                ),
+                "breaker_rejected": sch.breaker_rejected_kind.get(k, 0),
+                "poisoned": self._poisoned.get(k, 0),
+                "retried": self._retried.get(k, 0),
+                **(
+                    {"breaker": sch.breakers[k].describe(now)}
+                    if k in sch.breakers else {}
+                ),
+            }
+            for k in sch.kinds
+        }
         s.update(
-            queue_depth=self.scheduler.depth(),
-            submitted=self.scheduler.submitted,
-            rejected=self.scheduler.rejected,
+            queue_depth=sch.depth(),
+            submitted=sch.submitted,
+            rejected=sch.rejected,
             batches=self.batches,
+            retry_batches=self.retry_batches,
             completed=self.completed,
             worker_errors=self.worker_errors,
+            last_worker_error=self._last_error(),
+            per_kind=per_kind,
+            faults=self.faults.stats(),
             mean_occupancy=(
                 self._occupancy_sum / self.batches if self.batches else None
             ),
@@ -245,3 +435,43 @@ class Server:
         )
         obs.gauge("serve.batches", self.batches)
         return s
+
+    def health(self) -> dict:
+        """Liveness/readiness introspection, cheap enough to poll: the
+        worker thread's state, per-kind breaker states, the retained
+        last error, and the current graph version. ``status`` is
+        ``"ok"`` (serving normally — including worker-less pump()-
+        driven embedding, see ``worker_alive``), ``"degraded"`` (some
+        kind's breaker is open or half-open — other kinds still
+        serve), ``"down"`` (a started worker thread died: the front
+        door is open but nothing drains), or ``"closed"``."""
+        now = time.monotonic()
+        breakers = {
+            k: b.describe(now)
+            for k, b in self.scheduler.breakers.items()
+        }
+        worker_alive = (
+            self._worker is not None and self._worker.is_alive()
+        )
+        closed = self.scheduler.closed
+        if closed:
+            status = "closed"
+        elif self._worker is not None and not self._worker.is_alive():
+            status = "down"  # started once, died/joined: door open,
+            # nothing drains
+        elif any(b["state"] != "closed" for b in breakers.values()):
+            status = "degraded"
+        else:
+            status = "ok"
+        return {
+            "status": status,
+            "worker_alive": worker_alive,
+            "closed": closed,
+            "queue_depth": self.scheduler.depth(),
+            "worker_errors": self.worker_errors,
+            "worker_backoff_s": self._backoff_s,
+            "last_worker_error": self._last_error(),
+            "breakers": breakers,
+            "graph_version": self.engine.version_id,
+            "swaps": self.engine.swaps,
+        }

@@ -32,6 +32,22 @@ from .. import obs
 from ..models import PAD_ROOT
 
 
+def expire(req: "Request", where: str, on_timeout=None) -> bool:
+    """Settle an expired request with ``TimeoutError`` — the ONE place
+    the timeout message, the ``serve.requests{status=timeout}`` counter,
+    and the optional per-kind accounting hook live (three enforcement
+    points share it: the queue sweep, the pre-execution drop, and the
+    during-execution scatter check). Returns whether WE settled it."""
+    if settle(req.future, exc=TimeoutError(
+        f"request {req.rid} ({req.kind} root={req.root}) {where}"
+    )):
+        obs.count("serve.requests", kind=req.kind, status="timeout")
+        if on_timeout is not None:
+            on_timeout(req)
+        return True
+    return False
+
+
 def settle(fut: Future, *, result=None, exc: Exception | None = None
            ) -> bool:
     """``set_result``/``set_exception`` tolerating a concurrent
@@ -58,6 +74,7 @@ class Request:
     future: Future
     submitted_at: float
     deadline: float | None = None  # absolute; None = no timeout
+    attempts: int = 0  # FAILING executions ridden (retry-budget meter)
 
     def expired(self, now: float) -> bool:
         return self.deadline is not None and now >= self.deadline
@@ -75,14 +92,16 @@ def bucket_width(count: int, widths: tuple[int, ...]) -> int:
     return widths[-1]
 
 
-def assemble(requests: list[Request],
-             widths: tuple[int, ...]) -> np.ndarray:
+def assemble(requests: list[Request], widths: tuple[int, ...],
+             record: bool = True) -> np.ndarray:
     """Roots of ``requests`` as one int32 lane vector, padded with
     ``PAD_ROOT`` up to the bucket width. The batch must FIT the widest
     bucket — chunking an oversized backlog is the scheduler's job
     (``pop_ready`` flushes at most the widest width per batch); a
     direct caller exceeding it gets a ValueError, never a silent
-    truncation. Records the occupancy and padding-waste histograms."""
+    truncation. Records the occupancy and padding-waste histograms
+    unless ``record=False`` (bisection-retry sub-batches: re-recording
+    them would misread fault recovery as poor coalescing)."""
     W = bucket_width(len(requests), widths)
     if len(requests) > W:
         raise ValueError(
@@ -91,31 +110,35 @@ def assemble(requests: list[Request],
     sources = np.full(W, PAD_ROOT, np.int32)
     for k, r in enumerate(requests):
         sources[k] = r.root
-    kind = requests[0].kind
-    obs.observe("serve.batch.occupancy", len(requests) / W, kind=kind)
-    obs.observe("serve.batch.padding_waste", W - len(requests), kind=kind)
+    if record:
+        kind = requests[0].kind
+        obs.observe(
+            "serve.batch.occupancy", len(requests) / W, kind=kind
+        )
+        obs.observe(
+            "serve.batch.padding_waste", W - len(requests), kind=kind
+        )
     return sources
 
 
 def scatter(requests: list[Request], result: dict,
-            now: float | None = None) -> int:
+            now: float | None = None, on_timeout=None) -> int:
     """Hand each request its own lane of ``result`` (the engine's
     column-sliced output dict). Pad lanes are never touched: iteration
     is over the request list (lane k belongs to requests[k]); the
     remaining lanes simply have no owner. Requests whose future is
-    already settled (timeout/cancel) are skipped. Returns the number of
-    futures completed."""
+    already settled (timeout/cancel) are skipped; a request that
+    expired DURING execution is timed out here (``on_timeout(req)``,
+    when given, lets the server keep its per-kind accounting in step
+    with the obs counter). Returns the number of futures completed."""
     now = time.monotonic() if now is None else now
     done = 0
     for k, req in enumerate(requests):
         if req.future.done():
             continue
         if req.expired(now):
-            settle(req.future, exc=TimeoutError(
-                f"request {req.rid} ({req.kind} root={req.root}) "
-                "missed its deadline during execution"
-            ))
-            obs.count("serve.requests", kind=req.kind, status="timeout")
+            expire(req, "missed its deadline during execution",
+                   on_timeout)
             continue
         try:
             # lane COPIES, not views: a retained view would pin the
@@ -140,10 +163,3 @@ def scatter(requests: list[Request], result: dict,
     return done
 
 
-def fail(requests: list[Request], exc: Exception) -> None:
-    """Fail every still-pending request of a batch (engine-level error:
-    the batch never produced lanes)."""
-    for req in requests:
-        if not req.future.done():
-            if settle(req.future, exc=exc):
-                obs.count("serve.requests", kind=req.kind, status="error")

@@ -18,6 +18,13 @@ both halves for one graph:
   ``warmup()`` over the configured lane buckets guarantees steady-state
   requests never trace or compile.
 
+The loaded state lives on a ``GraphVersion`` and plans take their
+matrices as CALL-TIME jit arguments, so ``swap()`` can atomically
+replace the whole graph under the execution lock while the plan cache
+survives (zero retraces for same-shape versions) — the hot-swap half
+of dynamic-graph serving; ``build_version()`` constructs the next
+generation off-lock (double-buffered).
+
 The engine is synchronous and thread-safe: plan building, ``warmup``
 and ``execute`` serialize on one internal lock (one execution stream —
 a caller-thread ``warmup()`` cannot race the api worker's batches);
@@ -53,6 +60,115 @@ class _Plan:
     executions: int = 0
 
 
+@dataclasses.dataclass
+class GraphVersion:
+    """One immutable generation of loaded graph state — everything a
+    plan's operands come from, bundled so the engine can swap it
+    ATOMICALLY (one reference flip under the execution lock) while the
+    plan cache survives.
+
+    Plans are jitted over these matrices as ARGUMENTS (not closed-over
+    constants), so a swap to a version with identical operand shapes
+    (same nrows/ncols and ELL tile widths) re-uses every compiled
+    executable: zero retraces. A version with different shapes serves
+    correctly but pays one retrace per (kind, width) on first use —
+    visible in ``trace.serve`` / ``retraces_since``.
+    """
+
+    nrows: int
+    ncols: int
+    nnz: int
+    E: object                      # structural EllParMat
+    deg: object                    # host [nrows] in-degree
+    outdeg: object                 # host [ncols] out-degree
+    E_weighted: object = None      # None => unit weights (falls back to E)
+    P_ell: object = None           # pagerank transition matrix
+    dangling: object = None        # pagerank dangling DistVec
+    ET: object = None              # None => symmetric (E is its own T)
+    csc: object = None             # lazy CSC companion cache
+    coldeg: object = None          # lazy col-degree DistVec cache
+    host_coo: tuple | None = None  # retained iff keep_coo=True
+    vid: int = 0                   # assigned when installed/swapped in
+
+
+def _build_version(grid, rows, cols, nrows: int, ncols: int,
+                   weights, kinds: tuple[str, ...], symmetric: bool,
+                   keep_coo: bool) -> GraphVersion:
+    """Host-side construction of every artifact ``kinds`` need (the
+    body of the old ``from_coo``): dedup the COO, build the structural
+    / weighted / normalized / transposed matrices and the degree
+    tables. Runs WITHOUT any engine lock — this is the double-buffered
+    half of hot-swap: build the next generation while the current one
+    keeps serving."""
+    from ..parallel.ellmat import EllParMat
+    from ..parallel.vec import DistVec
+
+    rows = np.asarray(rows)
+    cols = np.asarray(cols)
+    n = int(nrows)
+    ncols = int(ncols)
+    key = rows.astype(np.int64) * np.int64(ncols) + cols
+    uniq, inv = np.unique(key, return_inverse=True)
+    if weights is not None:
+        w = np.full(len(uniq), np.inf, np.float32)
+        np.minimum.at(w, inv, np.asarray(weights, np.float32))
+        weights = w
+    rows = (uniq // ncols).astype(rows.dtype)
+    cols = (uniq % ncols).astype(cols.dtype)
+    if "bc" in kinds and symmetric:
+        # VERIFY the symmetry claim instead of trusting it: under
+        # symmetric=True bc reuses E as its own transpose, and a
+        # forgotten symmetric=False would make every served score
+        # silently wrong (the backward sweep would walk out-edges)
+        tkey = np.sort(
+            cols.astype(np.int64) * np.int64(ncols) + rows
+        )
+        if ncols != n or not np.array_equal(uniq, tkey):
+            raise ValueError(
+                "symmetric=True but the COO is not structurally "
+                "symmetric; pass symmetric=False (builds the "
+                "transpose for bc) or symmetrize the graph"
+            )
+    with obs.span("serve.load", nrows=n, nnz=int(len(rows))):
+        ones = np.ones(len(rows), np.float32)
+        E = EllParMat.from_host_coo(grid, rows, cols, ones, n, ncols)
+        E_weighted = (
+            EllParMat.from_host_coo(
+                grid, rows, cols,
+                np.asarray(weights, np.float32), n, ncols,
+            )
+            if weights is not None else None
+        )
+        # degree artifacts: rowdeg = in-edges per row; outdeg feeds
+        # the pagerank normalization and the lazy coldeg_vec()
+        # (device upload deferred until a plan consumes it)
+        deg = np.bincount(rows, minlength=n).astype(np.int32)
+        outdeg = np.bincount(cols, minlength=ncols).astype(np.int64)
+        P_ell = dangling = None
+        if "pagerank" in kinds:
+            # column-stochastic normalization, host-side (the
+            # reference's DimApply, PageRank.cpp:97-126)
+            pvals = (
+                1.0 / np.maximum(outdeg[cols], 1)
+            ).astype(np.float32)
+            P_ell = EllParMat.from_host_coo(
+                grid, rows, cols, pvals, n, ncols
+            )
+            dangling = DistVec.from_global(
+                grid, (outdeg == 0).astype(np.float32), align="col"
+            )
+        ET = None
+        if "bc" in kinds and not symmetric:
+            ET = EllParMat.from_host_coo(grid, cols, rows, ones,
+                                         ncols, n)
+    return GraphVersion(
+        nrows=n, ncols=ncols, nnz=int(len(rows)), E=E, deg=deg,
+        outdeg=outdeg, E_weighted=E_weighted, P_ell=P_ell,
+        dangling=dangling, ET=ET,
+        host_coo=(rows, cols, ncols) if keep_coo else None,
+    )
+
+
 class GraphEngine:
     """One graph, loaded and query-ready. See module docstring.
 
@@ -62,22 +178,35 @@ class GraphEngine:
     backpressured server (``combblas_tpu.serve.api.Server``).
     """
 
-    def __init__(self, grid, E, *, nrows: int, deg: np.ndarray,
+    def __init__(self, grid, E=None, *, nrows: int | None = None,
+                 deg: np.ndarray | None = None,
                  E_weighted=None, P_ell=None, dangling=None, ET=None,
                  csc=None, coldeg=None, kinds: tuple[str, ...] | None = None,
                  pagerank_opts: tuple = (0.85, 1e-6, 100),
-                 max_iters: int | None = None):
+                 max_iters: int | None = None,
+                 version: GraphVersion | None = None):
         self.grid = grid
-        self.E = E
-        self.nrows = int(nrows)
-        self.deg = np.asarray(deg)
-        weighted_given = E_weighted is not None
-        self.E_weighted = E_weighted if E_weighted is not None else E
-        self.P_ell = P_ell
-        self.dangling = dangling
-        self.ET = ET if ET is not None else E  # symmetric default
-        self.csc = csc
-        self.coldeg = coldeg
+        if version is None:
+            if E is None or nrows is None or deg is None:
+                raise ValueError(
+                    "GraphEngine needs either version= or E/nrows/deg"
+                )
+            version = GraphVersion(
+                nrows=int(nrows),
+                # read the real column count off E (a rectangular
+                # engine's dedup keys and swap validation depend on it)
+                ncols=int(getattr(E, "ncols", nrows)),
+                nnz=-1,
+                E=E, deg=np.asarray(deg),
+                outdeg=None,
+                E_weighted=E_weighted, P_ell=P_ell, dangling=dangling,
+                ET=ET, csc=csc, coldeg=coldeg,
+            )
+        version.vid = 1
+        self._version = version
+        self.nrows = int(version.nrows)
+        self.swaps = 0
+        weighted_given = version.E_weighted is not None
         # kinds this engine was built to serve: only these get plans —
         # a kind whose artifacts were never built must be rejected at
         # the front door, not served with a silently-wrong stand-in
@@ -86,13 +215,12 @@ class GraphEngine:
         if kinds is None:
             kinds = tuple(
                 k for k in KINDS
-                if (k != "pagerank" or P_ell is not None)
+                if (k != "pagerank" or version.P_ell is not None)
                 and (k != "sssp" or weighted_given)
             )
         self._kinds = tuple(kinds)
         self.pagerank_opts = pagerank_opts
         self.max_iters = max_iters
-        self._host_coo: tuple | None = None
         self._plans: dict[tuple[str, int], _Plan] = {}
         # ONE execution stream: plan building, warmup, and execute all
         # serialize here, so a caller-thread warmup() cannot race the
@@ -103,6 +231,73 @@ class GraphEngine:
         self._plans_lock = threading.Lock()
         self.plan_hits = 0
         self.plan_misses = 0
+
+    # -- version delegation ------------------------------------------------
+    # The loaded matrices live on the CURRENT GraphVersion; these
+    # properties keep the pre-versioning attribute surface (engine.E,
+    # engine.ET, ...) working while making every read swap-aware.
+
+    @property
+    def version(self) -> GraphVersion:
+        return self._version
+
+    @property
+    def version_id(self) -> int:
+        return self._version.vid
+
+    @property
+    def E(self):
+        return self._version.E
+
+    @property
+    def deg(self):
+        return self._version.deg
+
+    @property
+    def E_weighted(self):
+        v = self._version
+        return v.E_weighted if v.E_weighted is not None else v.E
+
+    @property
+    def P_ell(self):
+        return self._version.P_ell
+
+    @property
+    def dangling(self):
+        return self._version.dangling
+
+    @property
+    def ET(self):
+        v = self._version
+        return v.ET if v.ET is not None else v.E  # symmetric default
+
+    @property
+    def csc(self):
+        return self._version.csc
+
+    @csc.setter
+    def csc(self, value):
+        self._version.csc = value
+
+    @property
+    def coldeg(self):
+        return self._version.coldeg
+
+    @coldeg.setter
+    def coldeg(self, value):
+        self._version.coldeg = value
+
+    @property
+    def _outdeg(self):
+        return self._version.outdeg
+
+    @property
+    def _host_coo(self):
+        return self._version.host_coo
+
+    @_host_coo.setter
+    def _host_coo(self, value):
+        self._version.host_coo = value
 
     # -- construction ------------------------------------------------------
 
@@ -131,11 +326,6 @@ class GraphEngine:
         natural combine, matching the reference's dedup-at-construction
         convention, ``SpParMat.from_global_coo dedup_sr=``).
         """
-        from ..parallel.ellmat import EllParMat
-        from ..parallel.vec import DistVec
-
-        rows = np.asarray(rows)
-        cols = np.asarray(cols)
         ncols = nrows if ncols is None else int(ncols)
         n = int(nrows)
         if kinds is None:
@@ -144,72 +334,97 @@ class GraphEngine:
                 if (k != "sssp" or weights is not None)
                 and (k != "bc" or ncols == n)  # bc needs a square graph
             )
-        key = rows.astype(np.int64) * np.int64(ncols) + cols
-        uniq, inv = np.unique(key, return_inverse=True)
-        if weights is not None:
-            w = np.full(len(uniq), np.inf, np.float32)
-            np.minimum.at(w, inv, np.asarray(weights, np.float32))
-            weights = w
-        rows = (uniq // ncols).astype(rows.dtype)
-        cols = (uniq % ncols).astype(cols.dtype)
-        if "bc" in kinds and symmetric:
-            # VERIFY the symmetry claim instead of trusting it: under
-            # symmetric=True bc reuses E as its own transpose, and a
-            # forgotten symmetric=False would make every served score
-            # silently wrong (the backward sweep would walk out-edges)
-            tkey = np.sort(
-                cols.astype(np.int64) * np.int64(ncols) + rows
-            )
-            if ncols != n or not np.array_equal(uniq, tkey):
-                raise ValueError(
-                    "symmetric=True but the COO is not structurally "
-                    "symmetric; pass symmetric=False (builds the "
-                    "transpose for bc) or symmetrize the graph"
-                )
-        with obs.span("serve.load", nrows=n, nnz=int(len(rows))):
-            ones = np.ones(len(rows), np.float32)
-            E = EllParMat.from_host_coo(grid, rows, cols, ones, n, ncols)
-            E_weighted = (
-                EllParMat.from_host_coo(
-                    grid, rows, cols,
-                    np.asarray(weights, np.float32), n, ncols,
-                )
-                if weights is not None else None
-            )
-            # degree artifacts: rowdeg = in-edges per row; outdeg feeds
-            # the pagerank normalization and the lazy coldeg_vec()
-            # (device upload deferred until a plan consumes it)
-            deg = np.bincount(rows, minlength=n).astype(np.int32)
-            outdeg = np.bincount(cols, minlength=ncols).astype(np.int64)
-            P_ell = dangling = None
-            if "pagerank" in kinds:
-                # column-stochastic normalization, host-side (the
-                # reference's DimApply, PageRank.cpp:97-126)
-                pvals = (
-                    1.0 / np.maximum(outdeg[cols], 1)
-                ).astype(np.float32)
-                P_ell = EllParMat.from_host_coo(
-                    grid, rows, cols, pvals, n, ncols
-                )
-                dangling = DistVec.from_global(
-                    grid, (outdeg == 0).astype(np.float32), align="col"
-                )
-            ET = None
-            if "bc" in kinds and not symmetric:
-                ET = EllParMat.from_host_coo(grid, cols, rows, ones,
-                                             ncols, n)
-        eng = GraphEngine(
-            grid, E, nrows=n, deg=deg, E_weighted=E_weighted,
-            P_ell=P_ell, dangling=dangling, ET=ET,
-            kinds=tuple(kinds),
+        version = _build_version(
+            grid, rows, cols, n, ncols, weights, tuple(kinds),
+            symmetric, keep_coo,
+        )
+        return GraphEngine(
+            grid, version=version, kinds=tuple(kinds),
             pagerank_opts=(pagerank_alpha, pagerank_tol,
                            pagerank_max_iters),
             max_iters=max_iters,
         )
-        eng._outdeg = outdeg  # host [ncols] — feeds lazy coldeg_vec()
-        if keep_coo:
-            eng._host_coo = (rows, cols, ncols)  # lazy CSC-tier builds
-        return eng
+
+    # -- graph versions / hot-swap -----------------------------------------
+
+    def build_version(self, rows, cols, weights=None,
+                      ncols: int | None = None, symmetric: bool = True,
+                      keep_coo: bool = False) -> GraphVersion:
+        """Build the NEXT graph generation for this engine — same
+        nrows, same kinds — entirely outside the execution lock (the
+        double-buffered half of hot-swap: current version keeps
+        serving while this one is constructed host-side + uploaded).
+        Hand the result to ``swap()`` (or ``Server.swap_graph``)."""
+        t0 = time.perf_counter()
+        v = _build_version(
+            self.grid, rows, cols, self.nrows,
+            # default to the CURRENT version's ncols (not nrows): a
+            # rectangular engine's dedup key and index split are
+            # ncols-based, and a silently-wrong ncols would merge
+            # distinct edges
+            self._version.ncols if ncols is None else int(ncols),
+            weights, self._kinds, symmetric, keep_coo,
+        )
+        obs.observe("serve.swap.build_s", time.perf_counter() - t0)
+        return v
+
+    def swap(self, version: GraphVersion) -> float:
+        """Atomically install ``version`` as the current graph. Blocks
+        on the execution lock, so the in-flight batch (if any) finishes
+        on the OLD version; every later execute reads the new one. The
+        plan cache is untouched — plans take the matrices as call-time
+        arguments, so same-shape versions re-use every compiled
+        executable (zero retraces; a different-shape version retraces
+        once per plan, visibly). Returns the swap latency in seconds
+        (lock wait + pointer flip), also an obs histogram
+        (``serve.swap.latency_s``)."""
+        if not isinstance(version, GraphVersion):
+            raise TypeError(
+                f"swap() takes a GraphVersion (see build_version), "
+                f"got {type(version).__name__}"
+            )
+        if int(version.nrows) != self.nrows:
+            # results are [nrows, W]: changing nrows breaks every
+            # queued caller's contract — that is a new engine, not a
+            # version swap
+            raise ValueError(
+                f"version nrows={version.nrows} != engine nrows="
+                f"{self.nrows}; hot-swap preserves the result shape"
+            )
+        if int(version.ncols) != int(self._version.ncols):
+            raise ValueError(
+                f"version ncols={version.ncols} != engine ncols="
+                f"{self._version.ncols}; a different column space is "
+                "a new engine, not a version swap"
+            )
+        if "pagerank" in self._kinds and version.P_ell is None:
+            raise ValueError(
+                "engine serves 'pagerank' but the new version has no "
+                "P_ell; build it via engine.build_version(...)"
+            )
+        if (
+            "sssp" in self._kinds
+            and self._version.E_weighted is not None
+            and version.E_weighted is None
+        ):
+            # a weighted engine must stay weighted: the E_weighted
+            # property would silently fall back to the structural E
+            # and serve hop counts as distances (an engine built
+            # unit-weight by explicit kinds= opt-in stays consistent)
+            raise ValueError(
+                "engine serves weighted 'sssp' but the new version "
+                "has no weights; pass weights= to build_version"
+            )
+        t0 = time.perf_counter()
+        with self._exec_lock:
+            version.vid = self._version.vid + 1
+            self._version = version
+            self.swaps += 1
+        dt = time.perf_counter() - t0
+        obs.observe("serve.swap.latency_s", dt)
+        obs.gauge("serve.graph.version", version.vid)
+        obs.count("serve.swap.count")
+        return dt
 
     def coldeg_vec(self):
         """Col-aligned out-degree DistVec (the budget input of the
@@ -316,14 +531,12 @@ class GraphEngine:
                     E, sources, max_iters=self.max_iters,
                 )
 
-            args = (self.E,)
         elif kind == "sssp":
 
             def impl(E, sources):
                 trace_mark()
                 return _sssp_batch_impl(E, sources)
 
-            args = (self.E_weighted,)
         elif kind == "pagerank":
             if self.P_ell is None:
                 raise ValueError(
@@ -339,7 +552,6 @@ class GraphEngine:
                     max_iters=iters,
                 )
 
-            args = (self.P_ell, self.dangling)
         elif kind == "bc":
 
             def impl(E, ET, sources):
@@ -349,13 +561,27 @@ class GraphEngine:
                     per_lane=True,
                 )
 
-            args = (self.E, self.ET)
         else:
             raise ValueError(f"unknown query kind {kind!r}")
 
         jitted = jax.jit(impl)
-        plan.fn = lambda sources: jitted(*args, sources)
+        # operands resolved at CALL time from the current GraphVersion
+        # (not closed over): this is what lets swap() replace the graph
+        # under a surviving plan cache — same-shape operands hit the
+        # jit signature cache, different shapes retrace exactly once
+        plan.fn = lambda sources: jitted(*self._plan_args(kind), sources)
         return plan
+
+    def _plan_args(self, kind: str) -> tuple:
+        """The current version's operands for one kind (the properties
+        apply the unit-weight / symmetric-transpose fallbacks)."""
+        if kind == "bfs":
+            return (self.E,)
+        if kind == "sssp":
+            return (self.E_weighted,)
+        if kind == "pagerank":
+            return (self.P_ell, self.dangling)
+        return (self.E, self.ET)
 
     def warmup(self, kinds: tuple[str, ...] | None = None,
                widths: tuple[int, ...] = (1, 2, 4, 8, 16)) -> dict:
@@ -459,4 +685,7 @@ class GraphEngine:
             "plan_misses": misses,
             "nrows": self.nrows,
             "kinds": list(self.kinds()),
+            "graph_version": self._version.vid,
+            "graph_nnz": self._version.nnz,
+            "swaps": self.swaps,
         }

@@ -23,7 +23,12 @@ from collections import deque
 from concurrent.futures import Future
 
 from .. import obs
-from .batcher import Request, settle
+from .batcher import Request, expire, settle
+
+
+def _bump(d: dict, kind: str, n: int = 1) -> None:
+    """Per-kind counter bump (shared by Scheduler and Server)."""
+    d[kind] = d.get(kind, 0) + n
 
 
 class BackpressureError(RuntimeError):
@@ -41,6 +46,172 @@ class BackpressureError(RuntimeError):
         self.retry_after_s = retry_after_s
 
 
+class CircuitBreakerOpen(BackpressureError):
+    """This kind's breaker is open: recent executions failed
+    consecutively, so submits fast-fail instead of queueing work the
+    engine will predictably burn a device lane on. A subclass of
+    ``BackpressureError`` — retry-after semantics are identical, so
+    callers with a backoff loop need no new handling."""
+
+    def __init__(self, kind: str, retry_after_s: float):
+        RuntimeError.__init__(
+            self,
+            f"circuit breaker open for kind {kind!r}; retry after "
+            f"{retry_after_s:.3f}s",
+        )
+        self.kind = kind
+        self.retry_after_s = retry_after_s
+
+
+#: Circuit-breaker states (also the ``serve.breaker.state`` gauge
+#: values: closed=0, half_open=1, open=2).
+BREAKER_CLOSED = "closed"
+BREAKER_HALF_OPEN = "half_open"
+BREAKER_OPEN = "open"
+_BREAKER_GAUGE = {BREAKER_CLOSED: 0, BREAKER_HALF_OPEN: 1, BREAKER_OPEN: 2}
+
+
+class CircuitBreaker:
+    """Consecutive-failure breaker for one query kind.
+
+    CLOSED counts consecutive top-level batch failures; at
+    ``threshold`` it OPENs: admissions fast-fail with
+    ``CircuitBreakerOpen`` until ``cooldown_s`` elapses, then the next
+    admission flips it HALF_OPEN (a probe is let through). The probe
+    batch's outcome decides: success re-CLOSEs (cooldown resets),
+    failure re-OPENs with the cooldown doubled (capped at
+    ``cooldown_max_s``) — a persistently broken kind backs off
+    exponentially instead of retrying at a fixed cadence.
+
+    Failures are recorded at TOP-LEVEL batch granularity by the api
+    worker (bisection-recovery sub-batches are not counted), so one
+    poisoned request in an otherwise healthy engine cannot open the
+    breaker. All methods take an explicit ``now`` for deterministic
+    tests; thread-safe.
+    """
+
+    def __init__(self, threshold: int = 5, cooldown_s: float = 1.0,
+                 cooldown_max_s: float = 30.0):
+        if threshold < 1:
+            raise ValueError("breaker threshold must be >= 1")
+        self.threshold = int(threshold)
+        self.cooldown_s = float(cooldown_s)
+        self.cooldown_max_s = float(cooldown_max_s)
+        self._lock = threading.Lock()
+        self.state = BREAKER_CLOSED
+        self.failures = 0  # consecutive, while CLOSED
+        self.opened_at: float | None = None
+        self._cooldown = self.cooldown_s
+        self._probe_at: float | None = None  # half-open probe admitted
+        self.opened_total = 0
+        self.fast_fails = 0
+
+    def admit(self, now: float, kind: str = "") -> bool:
+        """May a submit of this kind be admitted right now? An OPEN
+        breaker whose cooldown has elapsed flips HALF_OPEN here — the
+        admitted request IS the probe, and it is the ONLY one: further
+        submits fast-fail until the probe's batch outcome decides (or
+        a full cooldown passes without an outcome — a probe that
+        expired in queue must not wedge the breaker half-open
+        forever)."""
+        with self._lock:
+            if self.state == BREAKER_OPEN:
+                if now - self.opened_at >= self._cooldown:
+                    self.state = BREAKER_HALF_OPEN
+                    self._probe_at = now
+                    obs.gauge("serve.breaker.state",
+                              _BREAKER_GAUGE[self.state], kind=kind)
+                    return True
+                self.fast_fails += 1
+                return False
+            if self.state == BREAKER_HALF_OPEN:
+                if (
+                    self._probe_at is None
+                    or now - self._probe_at >= self._cooldown
+                ):
+                    self._probe_at = now  # stale probe: re-probe
+                    return True
+                self.fast_fails += 1
+                return False
+            return True  # CLOSED
+
+    def release_probe(self) -> None:
+        """Give back a half-open probe slot whose request never made
+        it into the queue (queue-full or close() raced the admit) —
+        otherwise the kind stays fast-failing for a full cooldown with
+        no probe actually in flight."""
+        with self._lock:
+            if self.state == BREAKER_HALF_OPEN:
+                self._probe_at = None
+
+    def retry_after(self, now: float) -> float:
+        with self._lock:
+            if self.state == BREAKER_OPEN and self.opened_at is not None:
+                return max(0.0, self.opened_at + self._cooldown - now)
+            if (
+                self.state == BREAKER_HALF_OPEN
+                and self._probe_at is not None
+            ):
+                # waiting on the outstanding probe's outcome
+                return max(0.0, self._probe_at + self._cooldown - now)
+            return 0.0
+
+    def record_success(self, now: float, kind: str = "") -> None:
+        closed_now = False
+        with self._lock:
+            self.failures = 0
+            self._probe_at = None
+            if self.state != BREAKER_CLOSED:
+                self.state = BREAKER_CLOSED
+                self._cooldown = self.cooldown_s
+                closed_now = True
+        if closed_now:  # gauge only on TRANSITION: the steady-state
+            # healthy path (one record_success per batch) stays free
+            obs.gauge("serve.breaker.state", 0, kind=kind)
+
+    def record_failure(self, now: float, kind: str = "") -> None:
+        opened = False  # did THIS call transition to OPEN?
+        with self._lock:
+            if self.state == BREAKER_HALF_OPEN:
+                # the probe failed: back off harder
+                self.state = BREAKER_OPEN
+                self.opened_at = now
+                self._probe_at = None
+                self._cooldown = min(2 * self._cooldown,
+                                     self.cooldown_max_s)
+                self.opened_total += 1
+                opened = True
+            elif self.state == BREAKER_CLOSED:
+                self.failures += 1
+                if self.failures >= self.threshold:
+                    self.state = BREAKER_OPEN
+                    self.opened_at = now
+                    self._cooldown = self.cooldown_s
+                    self.opened_total += 1
+                    opened = True
+            else:  # OPEN: a straggler batch admitted pre-open failed —
+                # refresh the clock, but it is NOT a new open transition
+                self.opened_at = now
+            state = self.state
+        obs.gauge("serve.breaker.state", _BREAKER_GAUGE[state], kind=kind)
+        if opened:
+            obs.count("serve.breaker.opened", kind=kind)
+
+    def describe(self, now: float) -> dict:
+        with self._lock:
+            return {
+                "state": self.state,
+                "consecutive_failures": self.failures,
+                "opened_total": self.opened_total,
+                "fast_fails": self.fast_fails,
+                "cooldown_s": self._cooldown,
+                "retry_after_s": (
+                    max(0.0, self.opened_at + self._cooldown - now)
+                    if self.state == BREAKER_OPEN else 0.0
+                ),
+            }
+
+
 @dataclasses.dataclass(frozen=True)
 class ServeConfig:
     """Policy knobs for one server instance.
@@ -51,6 +222,22 @@ class ServeConfig:
     the latency a lonely request pays waiting for lane-mates;
     ``per_kind_max_wait`` overrides it per query kind. ``max_queue``
     bounds TOTAL pending requests across kinds (admission control).
+
+    Resilience knobs: ``retry_budget`` is the number of FAILING
+    executions one request may ride before its future fails. The
+    default (``None``) is computed from the widest lane bucket as
+    ``1 + ceil(log2(w_max))`` — exactly a full bisection (width 16:
+    16→8→4→2→1 = 5), so one poison request always fails ALONE and its
+    lane-mates survive regardless of configured widths. An explicit
+    smaller value is the operator's bounded-work/fail-fast choice: a
+    batch that exhausts it above width 1 fails innocents alongside the
+    poison. ``breaker_threshold`` consecutive
+    top-level batch failures open a kind's circuit breaker
+    (``None``/0 disables breakers); an open breaker fast-fails submits
+    for ``breaker_cooldown_s``, then a half-open probe decides —
+    failure doubles the cooldown up to ``breaker_cooldown_max_s``.
+    ``worker_backoff_s``/``worker_backoff_max_s`` bound the api
+    worker's exponential error backoff (reset on success).
     """
 
     lane_widths: tuple[int, ...] = (1, 2, 4, 8, 16)
@@ -58,6 +245,12 @@ class ServeConfig:
     max_wait_s: float = 0.01
     per_kind_max_wait: dict | None = None
     default_timeout_s: float | None = None
+    retry_budget: int | None = None  # None -> 1 + ceil(log2(w_max))
+    breaker_threshold: int | None = 5
+    breaker_cooldown_s: float = 1.0
+    breaker_cooldown_max_s: float = 30.0
+    worker_backoff_s: float = 0.05
+    worker_backoff_max_s: float = 2.0
 
     def __post_init__(self):
         if (
@@ -67,6 +260,19 @@ class ServeConfig:
         ):
             raise ValueError(
                 "lane_widths must be ascending positive ints"
+            )
+        if self.retry_budget is None:
+            # full-bisection budget for the widest configured bucket
+            # (frozen dataclass: assign via object.__setattr__)
+            object.__setattr__(
+                self, "retry_budget",
+                1 + max(0, int(self.lane_widths[-1]) - 1).bit_length(),
+            )
+        if self.retry_budget < 1:
+            raise ValueError("retry_budget must be >= 1")
+        if not (0 < self.worker_backoff_s <= self.worker_backoff_max_s):
+            raise ValueError(
+                "need 0 < worker_backoff_s <= worker_backoff_max_s"
             )
 
     def wait_for(self, kind: str) -> float:
@@ -89,8 +295,27 @@ class Scheduler:
         self._rid = itertools.count()
         self._lock = threading.Lock()
         self._closed = False
-        self.rejected = 0
+        self.rejected = 0  # backpressure only; breakers count separately
         self.submitted = 0
+        # per-kind disposition counters (Server.stats()'s per_kind
+        # table) — plain dicts bumped under _lock
+        self.rejected_kind: dict[str, int] = {}
+        self.invalid_kind: dict[str, int] = {}
+        self.timeout_kind: dict[str, int] = {}
+        self.breaker_rejected_kind: dict[str, int] = {}
+        # per-kind circuit breakers (execution health -> admission
+        # fast-fail); the api worker records batch outcomes into these
+        self.breakers: dict[str, CircuitBreaker] = (
+            {
+                k: CircuitBreaker(
+                    config.breaker_threshold,
+                    config.breaker_cooldown_s,
+                    config.breaker_cooldown_max_s,
+                )
+                for k in kinds
+            }
+            if config.breaker_threshold else {}
+        )
 
     def close(self) -> None:
         """Refuse all further admissions, PERMANENTLY (set under the
@@ -148,26 +373,47 @@ class Scheduler:
             fut.set_exception(
                 e if isinstance(e, ValueError) else ValueError(str(e))
             )
+            with self._lock:
+                _bump(self.invalid_kind, kind)
             obs.count("serve.requests", kind=kind, status="invalid")
             return fut
-        with self._lock:
-            if self._closed:  # re-check: close() may have raced the
-                # host-side validation above
-                raise RuntimeError(
-                    "serve.Server is closed; no further admissions"
+        breaker = self.breakers.get(kind)
+        if breaker is not None and not breaker.admit(now, kind):
+            # fast-fail OUTSIDE the queue lock: an open breaker is an
+            # execution-health fact, not a queue-depth one
+            with self._lock:
+                _bump(self.breaker_rejected_kind, kind)
+            obs.count("serve.breaker.fast_fail", kind=kind)
+            raise CircuitBreakerOpen(kind, breaker.retry_after(now))
+        try:
+            with self._lock:
+                if self._closed:  # re-check: close() may have raced
+                    # the host-side validation above
+                    raise RuntimeError(
+                        "serve.Server is closed; no further admissions"
+                    )
+                d = sum(len(q) for q in self._pending.values())
+                if d >= self.config.max_queue:
+                    self.rejected += 1
+                    _bump(self.rejected_kind, kind)
+                    obs.count("serve.queue.rejected", kind=kind)
+                    raise BackpressureError(
+                        d, self.config.wait_for(kind)
+                    )
+                req = Request(
+                    rid=next(self._rid), kind=kind, root=root_i,
+                    future=fut, submitted_at=now, deadline=deadline,
                 )
-            d = sum(len(q) for q in self._pending.values())
-            if d >= self.config.max_queue:
-                self.rejected += 1
-                obs.count("serve.queue.rejected", kind=kind)
-                raise BackpressureError(d, self.config.wait_for(kind))
-            req = Request(
-                rid=next(self._rid), kind=kind, root=root_i, future=fut,
-                submitted_at=now, deadline=deadline,
-            )
-            self._pending[kind].append(req)
-            self.submitted += 1
-            obs.gauge("serve.queue.depth", d + 1)
+                self._pending[kind].append(req)
+                self.submitted += 1
+                obs.gauge("serve.queue.depth", d + 1)
+        except (BackpressureError, RuntimeError):
+            if breaker is not None:
+                # this submit may have claimed the half-open probe
+                # slot in admit() above; it never entered the queue,
+                # so give the slot back (no-op unless half-open)
+                breaker.release_probe()
+            raise
         return fut
 
     # -- flush policy ------------------------------------------------------
@@ -266,12 +512,13 @@ class Scheduler:
                 "serve.queue.depth",
                 sum(len(q) for q in self._pending.values()),
             )
-        for req in timed_out:  # settle OUTSIDE the lock (see above)
-            settle(req.future, exc=TimeoutError(
-                f"request {req.rid} ({req.kind} root={req.root}) "
-                "expired in queue"
-            ))
-            obs.count("serve.requests", kind=req.kind, status="timeout")
+        if timed_out:
+            with self._lock:
+                for req in timed_out:
+                    _bump(self.timeout_kind, req.kind)
+        for req in timed_out:  # settle OUTSIDE the lock (see above;
+            # the per-kind bump already happened under it)
+            expire(req, "expired in queue")
         return out
 
     def drain(self) -> list[list[Request]]:

@@ -35,6 +35,14 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: excluded from the tier-1 `-m 'not slow'` run"
     )
+    # chaos tests run SEEDED fault schedules (serve/faults.py), so the
+    # fast ones are deterministic and stay in tier-1; long threaded
+    # soak variants carry BOTH markers (chaos + slow)
+    config.addinivalue_line(
+        "markers",
+        "chaos: fault-injection scenarios (seeded, deterministic; "
+        "tier-1 unless also marked slow)",
+    )
 
 
 @pytest.fixture(scope="session", autouse=True)

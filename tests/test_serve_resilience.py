@@ -1,0 +1,568 @@
+"""Resilient serving (ISSUE 6): fault injection, poisoned-batch
+bisection, execution-time deadline enforcement, per-kind circuit
+breakers, worker backoff, and atomic graph-version hot-swap.
+
+The recovery matrix: every failure path here is driven by the
+DETERMINISTIC fault-injection framework (serve/faults.py) — scripted
+call indices and seeded schedules, so the chaos tests replay
+bit-for-bit and stay in the tier-1 budget. Long threaded soaks are
+marked ``slow``; seeded chaos scenarios are marked ``chaos`` (both
+markers registered in conftest.py).
+"""
+
+import threading
+import time
+from concurrent.futures import Future
+
+import jax
+import numpy as np
+import pytest
+
+from combblas_tpu import obs
+from combblas_tpu.parallel.grid import Grid
+from combblas_tpu.serve import (
+    CircuitBreaker,
+    CircuitBreakerOpen,
+    FaultInjector,
+    GraphEngine,
+    InjectedFault,
+    ServeConfig,
+)
+from combblas_tpu.serve.batcher import Request
+from combblas_tpu.utils.rmat import rmat_symmetric_coo
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs():
+    obs.disable()
+    obs.reset()
+    yield
+    obs.disable()
+    obs.reset()
+
+
+SCALE = 7
+N = 1 << SCALE
+
+
+@pytest.fixture(scope="module")
+def graph():
+    rows, cols = rmat_symmetric_coo(jax.random.key(5), SCALE, 8)
+    return np.asarray(rows), np.asarray(cols)
+
+
+@pytest.fixture(scope="module")
+def engine(graph):
+    rows, cols = graph
+    return GraphEngine.from_coo(
+        Grid.make(2, 2), rows, cols, N, kinds=("bfs", "pagerank"),
+    )
+
+
+@pytest.fixture(scope="module")
+def live_roots(graph):
+    rows, _ = graph
+    deg = np.bincount(rows, minlength=N)
+    return np.flatnonzero(deg > 0).astype(np.int32)
+
+
+# --- fault injector ----------------------------------------------------------
+
+
+def test_injector_script_fires_at_exact_indices():
+    inj = FaultInjector()
+    inj.script("engine.execute", at=(1, 3))
+    fired = []
+    for i in range(5):
+        try:
+            inj.check("engine.execute")
+        except InjectedFault as e:
+            fired.append((i, e.call))
+    assert fired == [(1, 1), (3, 3)]
+    st = inj.stats()
+    assert st["calls"]["engine.execute"] == 5
+    assert st["fired"]["engine.execute"] == 2
+
+
+def test_injector_rate_is_seed_deterministic():
+    def schedule(seed):
+        inj = FaultInjector()
+        inj.rate("engine.execute", 0.3, seed=seed)
+        out = []
+        for _ in range(50):
+            try:
+                inj.check("engine.execute")
+                out.append(0)
+            except InjectedFault:
+                out.append(1)
+        return out
+
+    a, b = schedule(42), schedule(42)
+    assert a == b  # same seed + same call order = same schedule
+    assert 0 < sum(a) < 50  # actually fires, not always
+    assert schedule(7) != a  # and the seed matters
+
+
+def test_injector_unknown_point_and_unarmed_noop():
+    inj = FaultInjector()
+    with pytest.raises(ValueError, match="unknown fault point"):
+        inj.script("not.a.point", at=(0,))
+    inj.check("engine.execute")  # unarmed: no-op, no counters
+    assert inj.stats() == {"armed": [], "calls": {}, "fired": {}}
+    inj.when("batch.scatter", lambda ctx: ctx.get("kind") == "bfs")
+    with pytest.raises(InjectedFault):
+        inj.check("batch.scatter", kind="bfs")
+    inj.check("batch.scatter", kind="pagerank")  # predicate false
+    inj.clear()
+    inj.check("batch.scatter", kind="bfs")  # disarmed again
+
+
+# --- poisoned-batch isolation ------------------------------------------------
+
+
+def test_poisoned_batch_bisection_isolates_one_request(engine, live_roots):
+    """One poison request in a width-16 batch fails ALONE with the
+    injected error; its 15 lane-mates all succeed via bisection."""
+    srv = engine.serve(ServeConfig(lane_widths=(16,), max_wait_s=60.0))
+    roots = [int(r) for r in live_roots[:16]]
+    poison = roots[5]
+    srv.faults.when(
+        "engine.execute", lambda ctx: poison in ctx["roots"]
+    )
+    futs = {r: srv.submit("bfs", r) for r in roots}
+    srv.pump(force=True)
+    for r, f in futs.items():
+        assert f.done(), r  # NO stranded futures
+        if r == poison:
+            assert isinstance(f.exception(timeout=0), InjectedFault)
+        else:
+            assert f.result(timeout=0)["levels"][r] == 0, r
+    st = srv.stats()
+    assert st["per_kind"]["bfs"]["poisoned"] == 1
+    assert st["per_kind"]["bfs"]["retried"] > 0
+    # one poison must NOT open the breaker (top-level granularity)
+    assert st["per_kind"]["bfs"]["breaker"]["state"] == "closed"
+
+
+def test_transient_fault_retries_and_succeeds(engine, live_roots):
+    """A fault that fires once (scripted at call 0) costs a retry, not
+    a request: every future completes ok."""
+    srv = engine.serve(ServeConfig(lane_widths=(8,), max_wait_s=60.0))
+    srv.faults.script("engine.execute", at=(0,))
+    roots = [int(r) for r in live_roots[:8]]
+    futs = [srv.submit("bfs", r) for r in roots]
+    srv.pump(force=True)
+    for r, f in zip(roots, futs):
+        assert f.result(timeout=0)["levels"][r] == 0
+    assert srv.stats()["per_kind"]["bfs"]["poisoned"] == 0
+
+
+def test_persistent_fault_exhausts_budget_no_stranded(engine, live_roots):
+    """Under a 100% execute-fault rate every request fails after its
+    bounded retry budget — settled futures, bounded work, nothing
+    hangs."""
+    srv = engine.serve(ServeConfig(
+        lane_widths=(4,), max_wait_s=60.0, retry_budget=3,
+    ))
+    srv.faults.rate("engine.execute", 1.0, seed=0)
+    futs = [srv.submit("bfs", int(r)) for r in live_roots[:4]]
+    srv.pump(force=True)
+    assert all(f.done() for f in futs)
+    assert all(
+        isinstance(f.exception(timeout=0), InjectedFault) for f in futs
+    )
+    # budget 3: each request rides exactly 3 failing executions
+    # (width 4, width 2, then alone): 1 top-level batch + 2+4 retry
+    # sub-batches — bounded work, and coalescing stats stay clean
+    assert srv.batches == 1
+    assert srv.retry_batches == 6
+    assert srv.scheduler.depth() == 0
+    assert srv.stats()["per_kind"]["bfs"]["poisoned"] == 4
+
+
+def test_scatter_fault_is_recovered_like_execute(engine, live_roots):
+    """The batch.scatter failure point rides the same bisection ladder
+    — a fault after execution still settles every future."""
+    srv = engine.serve(ServeConfig(lane_widths=(4,), max_wait_s=60.0))
+    srv.faults.script("batch.scatter", at=(0,))
+    roots = [int(r) for r in live_roots[:4]]
+    futs = [srv.submit("bfs", r) for r in roots]
+    srv.pump(force=True)
+    for r, f in zip(roots, futs):
+        assert f.result(timeout=0)["levels"][r] == 0
+
+
+# --- execution-time deadline enforcement -------------------------------------
+
+
+def test_expired_request_dropped_before_execution(engine, live_roots):
+    """A request already past its deadline at execution time is
+    settled with TimeoutError WITHOUT occupying a device lane."""
+    srv = engine.serve(ServeConfig(lane_widths=(4,), max_wait_s=60.0))
+    now = time.monotonic()
+    dead = Request(
+        rid=0, kind="bfs", root=int(live_roots[0]), future=Future(),
+        submitted_at=now - 1.0, deadline=now - 0.5,
+    )
+    live = Request(
+        rid=1, kind="bfs", root=int(live_roots[1]), future=Future(),
+        submitted_at=now, deadline=None,
+    )
+    before = srv.batches
+    srv._run_batch([dead, live])
+    assert isinstance(dead.future.exception(timeout=0), TimeoutError)
+    assert live.future.result(timeout=0)["levels"][int(live_roots[1])] == 0
+    assert srv.batches == before + 1  # ONE batch, dead lane never rode
+    assert srv.stats()["per_kind"]["bfs"]["timeout"] == 1
+
+
+# --- circuit breakers --------------------------------------------------------
+
+
+def test_retry_budget_defaults_to_full_bisection():
+    """The default budget tracks the widest lane bucket (1 + log2):
+    one poison always fails alone, at ANY configured width."""
+    assert ServeConfig().retry_budget == 5  # widths (1..16)
+    assert ServeConfig(lane_widths=(1, 2, 4, 8, 16, 32)).retry_budget == 6
+    assert ServeConfig(lane_widths=(1,)).retry_budget == 1
+    assert ServeConfig(lane_widths=(4,), retry_budget=2).retry_budget == 2
+    with pytest.raises(ValueError, match="retry_budget"):
+        ServeConfig(retry_budget=0)
+
+
+def test_half_open_probe_released_on_queue_full(engine, live_roots):
+    """A submit that claims the half-open probe slot but is then
+    rejected by the full queue must RELEASE the slot — otherwise the
+    kind fast-fails for a whole cooldown with no probe in flight."""
+    srv = engine.serve(ServeConfig(
+        lane_widths=(1,), max_wait_s=60.0, retry_budget=1, max_queue=1,
+        breaker_threshold=1, breaker_cooldown_s=0.01,
+    ))
+    srv.faults.rate("engine.execute", 1.0, seed=0)
+    srv.submit("bfs", int(live_roots[0]))
+    srv.pump(force=True)  # one failure opens the breaker (threshold 1)
+    srv.faults.clear()
+    assert srv.health()["breakers"]["bfs"]["state"] == "open"
+    time.sleep(0.02)  # cooldown elapses
+    # fill the queue with the OTHER kind so the probe submit hits
+    # queue-full AFTER claiming the probe slot
+    srv.scheduler.submit("pagerank", int(live_roots[0]))
+    from combblas_tpu.serve import BackpressureError
+    with pytest.raises(BackpressureError):
+        srv.submit("bfs", int(live_roots[1]))
+    srv.pump(force=True)  # drains pagerank: capacity is back
+    # the probe slot was released: the next submit IS the probe and
+    # closes the breaker, instead of fast-failing for a cooldown
+    probe = srv.submit("bfs", int(live_roots[1]))
+    srv.pump(force=True)
+    assert probe.result(timeout=0)["levels"][int(live_roots[1])] == 0
+    assert srv.health()["breakers"]["bfs"]["state"] == "closed"
+
+
+def test_breaker_state_machine_deterministic():
+    """Unit cycle with an injected clock: closed -> open at the
+    threshold -> fast-fail during cooldown -> half-open probe ->
+    close on success; a failed probe doubles the cooldown (capped)."""
+    br = CircuitBreaker(threshold=3, cooldown_s=1.0, cooldown_max_s=3.0)
+    t = 100.0
+    for _ in range(2):
+        br.record_failure(t)
+    assert br.state == "closed"
+    br.record_failure(t)
+    assert br.state == "open" and br.opened_total == 1
+    assert not br.admit(t + 0.5)  # cooling: fast-fail
+    assert br.retry_after(t + 0.5) == pytest.approx(0.5)
+    assert br.admit(t + 1.0)  # cooldown elapsed: half-open probe
+    assert br.state == "half_open"
+    assert not br.admit(t + 1.05)  # ONE probe only: others fast-fail
+    assert br.retry_after(t + 1.05) > 0
+    assert br.admit(t + 1.0 + 1.0)  # stale probe (no outcome): re-probe
+    br.record_failure(t + 1.1)  # probe failed: reopen, cooldown x2
+    assert br.state == "open" and br.describe(t)["cooldown_s"] == 2.0
+    assert not br.admit(t + 2.0)
+    assert br.admit(t + 1.1 + 2.0)
+    br.record_success(t + 3.2)  # probe succeeded: closed, cooldown reset
+    assert br.state == "closed"
+    assert br.describe(t)["cooldown_s"] == 1.0
+    assert br.fast_fails == 3  # 2 while open + 1 during the probe
+
+
+def test_breaker_opens_fast_fails_and_recovers(engine, live_roots):
+    """End-to-end: consecutive injected batch failures open the bfs
+    breaker; submits fast-fail with CircuitBreakerOpen (retry-after
+    hint); after the cooldown a half-open probe closes it; OTHER kinds
+    keep serving throughout."""
+    srv = engine.serve(ServeConfig(
+        lane_widths=(1,), max_wait_s=60.0, retry_budget=1,
+        breaker_threshold=3, breaker_cooldown_s=0.05,
+    ))
+    srv.faults.rate("engine.execute", 1.0, seed=0)
+    for _ in range(3):  # three top-level failures
+        srv.submit("bfs", int(live_roots[0]))
+        srv.pump(force=True)
+    assert srv.health()["breakers"]["bfs"]["state"] == "open"
+    with pytest.raises(CircuitBreakerOpen) as ei:
+        srv.submit("bfs", int(live_roots[0]))
+    assert ei.value.retry_after_s <= 0.05
+    assert srv.health()["status"] == "degraded"
+    # pagerank is unaffected: per-KIND isolation
+    f = srv.submit("pagerank", int(live_roots[1]))
+    srv.faults.clear()  # engine healthy again
+    srv.pump(force=True)
+    assert f.result(timeout=0)["ranks"].sum() > 0
+    time.sleep(0.06)  # cooldown elapses
+    probe = srv.submit("bfs", int(live_roots[2]))  # half-open probe
+    assert srv.health()["breakers"]["bfs"]["state"] == "half_open"
+    srv.pump(force=True)
+    assert probe.result(timeout=0)["levels"][int(live_roots[2])] == 0
+    assert srv.health()["breakers"]["bfs"]["state"] == "closed"
+    assert srv.health()["status"] == "ok" or not srv._worker  # no worker
+    st = srv.stats()["per_kind"]["bfs"]
+    assert st["breaker_rejected"] == 1
+    assert st["breaker"]["opened_total"] == 1
+
+
+# --- submit_many prefix semantics under injected faults ----------------------
+
+
+def test_submit_many_prefix_under_injected_admit_fault(engine, live_roots):
+    """An admission fault mid-loop: the admitted prefix stays live, the
+    remainder's futures all carry the injected error — one future per
+    root, in order, nothing lost."""
+    srv = engine.serve(ServeConfig(lane_widths=(4,), max_wait_s=60.0))
+    srv.faults.script("scheduler.admit", at=(2,))
+    roots = [int(r) for r in live_roots[:5]]
+    futs = srv.submit_many("bfs", roots)
+    assert len(futs) == 5
+    assert [f.done() for f in futs] == [False, False, True, True, True]
+    assert all(
+        isinstance(f.exception(timeout=0), InjectedFault)
+        for f in futs[2:]
+    )
+    srv.pump(force=True)  # the admitted prefix still completes
+    for r, f in zip(roots[:2], futs[:2]):
+        assert f.result(timeout=0)["levels"][r] == 0
+
+
+# --- worker backoff ----------------------------------------------------------
+
+
+def test_worker_error_backoff_grows_and_resets(engine, live_roots):
+    """A scheduler-level error makes the worker back off exponentially
+    (capped) instead of spinning at 50 ms; a successful pump resets it
+    and the retained error surfaces in stats() with a timestamp."""
+    srv = engine.serve(ServeConfig(
+        lane_widths=(1,), max_wait_s=0.001,
+        worker_backoff_s=0.002, worker_backoff_max_s=0.016,
+    ))
+    real_pop = srv.scheduler.pop_ready
+    boom = RuntimeError("scheduler bug (injected)")
+
+    def bad_pop(*a, **k):
+        raise boom
+
+    srv.scheduler.pop_ready = bad_pop
+    srv.start()
+    try:
+        srv.submit("bfs", int(live_roots[0]))
+        deadline = time.monotonic() + 5
+        while srv.worker_errors < 4 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert srv.worker_errors >= 4
+        assert srv._backoff_s > 0.002  # grew
+        st = srv.stats()
+        assert st["last_worker_error"]["repr"] == repr(boom)
+        assert st["last_worker_error"]["at"] is not None
+        srv.scheduler.pop_ready = real_pop  # heal
+        f = srv.submit("bfs", int(live_roots[1]))
+        assert f.result(timeout=30)["levels"][int(live_roots[1])] == 0
+        deadline = time.monotonic() + 5
+        while srv._backoff_s != 0.002 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert srv._backoff_s == 0.002  # reset on success
+    finally:
+        srv.scheduler.pop_ready = real_pop
+        srv.close()
+
+
+# --- graph-version hot-swap --------------------------------------------------
+
+
+def test_swap_same_shape_keeps_plans_zero_retraces(engine, graph,
+                                                   live_roots):
+    """Swapping to a same-shape version (here: rebuilt from the same
+    COO) keeps every compiled plan warm — zero retraces — and bumps
+    the version id atomically."""
+    rows, cols = graph
+    engine.warmup(kinds=("bfs",), widths=(1, 4))
+    v0 = engine.version_id
+    mark = engine.trace_mark()
+    r0 = engine.execute("bfs", live_roots[:4])
+    v1 = engine.build_version(rows, cols)
+    swap_s = engine.swap(v1)
+    assert engine.version_id == v0 + 1 and swap_s >= 0
+    r1 = engine.execute("bfs", live_roots[:4])
+    np.testing.assert_array_equal(r0["levels"], r1["levels"])
+    assert engine.retraces_since(mark) == 0  # plan cache SURVIVED
+    assert engine.stats()["swaps"] >= 1
+
+
+def test_swap_changes_served_results(graph):
+    """A swap to a genuinely different graph changes answers: the path
+    graph's far end moves closer when we add a chord."""
+    rows = np.array([0, 1, 1, 2, 2, 3], np.int64)  # 0-1-2-3 path
+    cols = np.array([1, 0, 2, 1, 3, 2], np.int64)
+    eng = GraphEngine.from_coo(Grid.make(1, 1), rows, cols, 4,
+                               kinds=("bfs",))
+    before = eng.execute("bfs", np.asarray([0], np.int32))
+    assert before["levels"][3, 0] == 3
+    rows2 = np.concatenate([rows, [0, 3]])
+    cols2 = np.concatenate([cols, [3, 0]])
+    eng.swap(eng.build_version(rows2, cols2))
+    after = eng.execute("bfs", np.asarray([0], np.int32))
+    assert after["levels"][3, 0] == 1  # the chord is live
+
+
+def test_swap_validation_rejects_bad_versions(engine, graph):
+    rows, cols = graph
+    with pytest.raises(TypeError, match="GraphVersion"):
+        engine.swap("not-a-version")
+    small = GraphEngine.from_coo(
+        Grid.make(1, 1), np.array([0, 1]), np.array([1, 0]), 2,
+        kinds=("bfs",),
+    )
+    wrong_n = small.build_version(np.array([0, 1]), np.array([1, 0]))
+    with pytest.raises(ValueError, match="nrows"):
+        engine.swap(wrong_n)
+    # rectangular engines: build_version defaults ncols to the CURRENT
+    # version's ncols (not nrows — the dedup key is ncols-based), and
+    # swap rejects a changed column space
+    rect = GraphEngine.from_coo(
+        Grid.make(1, 1), np.array([0, 3]), np.array([5, 2]), 4,
+        ncols=8, kinds=("bfs",), symmetric=False,
+    )
+    v_rect = rect.build_version(np.array([1, 2]), np.array([7, 0]))
+    assert v_rect.ncols == 8
+    rect.swap(v_rect)  # same-shape rectangular swap is fine
+    v_sq = rect.build_version(
+        np.array([1, 2]), np.array([3, 0]), ncols=4,
+    )
+    with pytest.raises(ValueError, match="ncols"):
+        rect.swap(v_sq)
+    # a WEIGHTED sssp engine must not silently downgrade to hop counts
+    weighted = GraphEngine.from_coo(
+        Grid.make(1, 1), rows, cols, N,
+        weights=np.ones(len(rows), np.float32), kinds=("bfs", "sssp"),
+    )
+    with pytest.raises(ValueError, match="weights"):
+        weighted.swap(weighted.build_version(rows, cols))  # no weights=
+    weighted.swap(weighted.build_version(
+        rows, cols, weights=np.ones(len(rows), np.float32)
+    ))  # weighted replacement is fine
+    assert weighted.version_id == 2
+    # an injected swap fault leaves the OLD version serving
+    srv = engine.serve(ServeConfig(lane_widths=(1,), max_wait_s=60.0))
+    srv.faults.script("engine.swap", at=(0,))
+    vid = engine.version_id
+    with pytest.raises(InjectedFault):
+        srv.swap_graph(engine.build_version(rows, cols))
+    assert engine.version_id == vid  # rollback-by-never-applying
+
+
+def test_hot_swap_under_concurrent_load_zero_stranded(engine, graph,
+                                                      live_roots):
+    """The acceptance gate: an atomic swap under sustained threaded
+    load completes with ZERO failed in-flight queries, zero stranded
+    futures, and zero post-swap retraces (same-shape version)."""
+    rows, cols = graph
+    engine.warmup(kinds=("bfs", "pagerank"), widths=(1, 2, 4, 8))
+    v_next = engine.build_version(rows, cols)  # built OFF the hot path
+    v_before = engine.version_id
+    mark = engine.trace_mark()
+    srv = engine.serve(ServeConfig(
+        lane_widths=(1, 2, 4, 8), max_wait_s=0.002, max_queue=512,
+    )).start()
+    try:
+        kinds = ("bfs", "pagerank")
+        futs = []
+        swap_info = {}
+        for i in range(60):
+            futs.append(srv.submit(
+                kinds[i % 2], int(live_roots[i % len(live_roots)])
+            ))
+            if i == 30:  # mid-stream, in-flight batches everywhere
+                swap_info = srv.swap_graph(v_next)
+        results = [f.result(timeout=120) for f in futs]
+        assert len(results) == 60  # all settled, none stranded/failed
+        assert swap_info["version"] == v_before + 1
+        assert engine.retraces_since(mark) == 0  # plans survived
+        st = srv.stats()
+        assert st["completed"] == 60
+        assert st["per_kind"]["bfs"]["poisoned"] == 0
+    finally:
+        srv.close()
+
+
+# --- seeded chaos scenarios --------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_chaos_availability_under_seeded_execute_faults(engine,
+                                                        live_roots):
+    """The ISSUE 6 acceptance bar, deterministically: with a 5%
+    seeded execute-fault rate, >= 95% of well-formed requests still
+    complete (bisection absorbs the damage), no future is stranded,
+    and the recovery work is visible in stats."""
+    srv = engine.serve(ServeConfig(
+        lane_widths=(1, 2, 4, 8, 16), max_wait_s=60.0, max_queue=512,
+    ))
+    # seed 11 fires on the 4th execute call at p=0.05 — the schedule
+    # is deterministic, so the recovery path provably runs
+    srv.faults.rate("engine.execute", 0.05, seed=11)
+    nq = 200
+    futs = [
+        srv.submit("bfs", int(live_roots[i % len(live_roots)]))
+        for i in range(nq)
+    ]
+    while srv.scheduler.depth():
+        srv.pump(force=True)
+    assert all(f.done() for f in futs)  # zero stranded
+    ok = sum(1 for f in futs if f.exception(timeout=0) is None)
+    assert ok / nq >= 0.95, f"availability {ok}/{nq}"
+    st = srv.stats()
+    assert st["faults"]["fired"].get("engine.execute", 0) > 0
+    assert st["per_kind"]["bfs"]["retried"] > 0  # recovery really ran
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_chaos_soak_threaded_faults_and_swap_storm(engine, graph,
+                                                   live_roots):
+    """Threaded soak: seeded faults + repeated hot-swaps under load.
+    Everything settles; availability holds; swaps never strand."""
+    rows, cols = graph
+    engine.warmup(kinds=("bfs", "pagerank"), widths=(1, 2, 4, 8, 16))
+    versions = [engine.build_version(rows, cols) for _ in range(3)]
+    swaps_before = engine.swaps
+    srv = engine.serve(ServeConfig(
+        lane_widths=(1, 2, 4, 8, 16), max_wait_s=0.005, max_queue=1024,
+    )).start()
+    srv.faults.rate("engine.execute", 0.05, seed=99)
+    try:
+        futs = []
+        for i in range(300):
+            futs.append(srv.submit(
+                ("bfs", "pagerank")[i % 2],
+                int(live_roots[i % len(live_roots)]),
+            ))
+            if i in (75, 150, 225):
+                srv.swap_graph(versions[(i // 75) - 1])
+        done = [f for f in futs if not f.cancelled()]
+        ok = sum(
+            1 for f in done if f.exception(timeout=120) is None
+        )
+        assert all(f.done() for f in futs)
+        assert ok / len(futs) >= 0.95
+        assert engine.swaps == swaps_before + 3
+    finally:
+        srv.close()
