@@ -16,6 +16,24 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 SCALE = int(os.environ.get("BENCH_SCALE", "14"))
 REPS = int(os.environ.get("BENCH_REPS", "3"))
+# Square process grid side: BENCH_PR=2 runs the DISTRIBUTED SUMMA on a
+# pr x pr virtual CPU mesh (XLA host-device-count, the conftest.py
+# pattern) — the large-scale distributed capture knob (r9's scale-17
+# record). 1 (default) keeps the single-device protocol unchanged.
+PR = int(os.environ.get("BENCH_PR", "1"))
+# Windowed-tier schedule: BENCH_RING=1 runs the carousel
+# (neighbor-rotation) schedule, BENCH_PIPELINE=0 pins its serial-chain
+# control — the pipelined-vs-unpipelined A/B of ISSUE 7.
+RING = os.environ.get("BENCH_RING", "0") == "1"
+PIPELINE = os.environ.get("BENCH_PIPELINE", "1") == "1"
+# Input pattern: rmat (default) | banded — a |i-j| <= n/64 band whose
+# A² support leaves most 2D windows symbolically EMPTY (the packed-
+# launch ratio showcase; R-MAT support is too uniform to skip much).
+PATTERN = os.environ.get("BENCH_PATTERN", "rmat")
+# Windowed multi-device dispatch: fused (default, one shard_map graph)
+# | blocked (one small program per row block — the live-set bound that
+# fits scale-17+ tiles in RAM; scatter backend only).
+DISPATCH = os.environ.get("BENCH_DISPATCH", "fused")
 # esc | mxu | scan | scanphased | windowed | auto  (auto = the tier
 # router's choice, sized host-side like every other kernel here)
 KERNEL = os.environ.get("BENCH_KERNEL", "esc")
@@ -39,10 +57,20 @@ EDGEFACTOR = int(os.environ.get("BENCH_EDGEFACTOR", "8"))
 # bf16 is the fast mode (exact for 0/1 counts < 2^24).
 DOT_MODE = os.environ.get("BENCH_DOT_MODE", "f32")
 _EFTAG = f"ef{EDGEFACTOR}" if EDGEFACTOR != 8 else ""
+_GRIDTAG = f"_p{PR}x{PR}" if PR > 1 else ""
+_RINGTAG = ("_ring" if PIPELINE else "_ringserial") if RING else ""
 
 
 def main():
+    if PR > 1 and os.environ.get("JAX_PLATFORMS", "") != "tpu":
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={PR * PR}"
+        )
     import jax
+
+    if PR > 1 and os.environ.get("JAX_PLATFORMS", "") != "tpu":
+        jax.config.update("jax_platforms", "cpu")
     import numpy as np
 
     from combblas_tpu import PLUS_TIMES, obs
@@ -60,9 +88,21 @@ def main():
     # spgemm.windowed.windows_skipped, spgemm.auto.mask_density)
     obs.enable_sidecar(f"spgemm-{KERNEL}")
 
-    grid = Grid.make(1, 1)
+    grid = Grid.make(PR, PR)
     n = 1 << SCALE
-    rows, cols = rmat_symmetric_coo_host(5, SCALE, EDGEFACTOR)
+    if PATTERN == "banded":
+        bw = max(n // 64, 1)
+        ri = np.arange(n, dtype=np.int64)
+        rows = np.concatenate(
+            [ri for _ in range(-3, 4)]
+        )
+        cols = np.concatenate(
+            [np.clip(ri + o * max(bw // 3, 1), 0, n - 1)
+             for o in range(-3, 4)]
+        )
+    else:
+        assert PATTERN == "rmat", PATTERN
+        rows, cols = rmat_symmetric_coo_host(5, SCALE, EDGEFACTOR)
     key = rows * np.int64(n) + cols
     uniq = np.unique(key)
     ru, cu = uniq // n, uniq % n
@@ -180,6 +220,14 @@ def main():
             )
             nskip = sum(sum(row) for row in skip)
             obs.count("spgemm.windowed.col_windows_skipped", nskip)
+            from combblas_tpu.parallel.spgemm import packed_windows_2d
+
+            npk = len(packed_windows_2d(skip))
+            ntot = sum(len(row) for row in skip)
+            obs.count("spgemm.windowed.windows_packed", npk)
+            obs.gauge(
+                "spgemm.windowed.pack_ratio", npk / ntot if ntot else 0.0
+            )
             obs.gauge(
                 "spgemm.windowed.col_windows", len(skip[0]) if skip else 0
             )
@@ -194,6 +242,9 @@ def main():
                 "block_cols": block_cols,
                 "col_windows": len(skip[0]) if skip else 0,
                 "col_windows_skipped": int(nskip),
+                "windows_packed": int(npk),
+                "windows_total": int(ntot),
+                "pack_ratio": round(npk / ntot, 4) if ntot else 0.0,
                 "panel_cap": int(panel_cap),
                 "panel_cells": int(
                     _pad128(grid.local_rows(n)) * _pad128(block_cols)
@@ -213,7 +264,7 @@ def main():
                     flop_caps=flop_caps, out_caps=out_caps, skip=skip,
                     backend="dot", mode=DOT_MODE,
                     chunk_w=WINDOWED_CHUNK_W, block_cols=block_cols,
-                    panel_cap=panel_cap,
+                    panel_cap=panel_cap, ring=RING, pipeline=PIPELINE,
                 )
         else:
             pb = summa_rowblock_flops_host(
@@ -227,6 +278,14 @@ def main():
                 pb, pt, block_rows, lrA, lcB
             )
             obs.count("spgemm.windowed.windows_skipped", sum(skip))
+            from combblas_tpu.parallel.spgemm import packed_windows
+
+            npk = len(packed_windows(skip))
+            obs.count("spgemm.windowed.windows_packed", npk)
+            obs.gauge(
+                "spgemm.windowed.pack_ratio",
+                npk / len(skip) if skip else 0.0,
+            )
             obs.gauge("spgemm.windowed.blocks", len(skip))
             # same quantity as the library emitter (parallel/spgemm.py:
             # spgemm_windowed): raw symbolic output bound over dense cells
@@ -235,6 +294,21 @@ def main():
                 float(np.asarray(pt).sum(axis=1).max(axis=(-1, -2)).sum())
                 / max(lrA * lcB, 1),
             )
+            extra = {
+                "windows_packed": int(npk),
+                "windows_total": len(skip),
+                "pack_ratio": (
+                    round(npk / len(skip), 4) if skip else 0.0
+                ),
+            }
+
+            if DISPATCH == "blocked" and grid.size > 1:
+                # per-block programs share compiles when caps match:
+                # pow2-round so most blocks hit one executable
+                rnd = lambda x: 1 << (max(int(x), 1) - 1).bit_length()
+                flop_caps = tuple(rnd(fcp) for fcp in flop_caps)
+                out_caps = tuple(rnd(ocp) for ocp in out_caps)
+                extra["dispatch"] = "blocked"
 
             def mult(a):
                 # grid 1x1 here: the per-block-program fast path (the
@@ -245,10 +319,21 @@ def main():
                         flop_caps=flop_caps, out_caps=out_caps, skip=skip,
                         chunk_w=WINDOWED_CHUNK_W,
                     )
+                if DISPATCH == "blocked":
+                    from combblas_tpu.parallel.spgemm import (
+                        summa_spgemm_windowed_blocked,
+                    )
+
+                    return summa_spgemm_windowed_blocked(
+                        PLUS_TIMES, a, a, block_rows=block_rows,
+                        flop_caps=flop_caps, out_caps=out_caps,
+                        skip=skip, chunk_w=WINDOWED_CHUNK_W,
+                    )
                 return summa_spgemm_windowed(
                     PLUS_TIMES, a, a, block_rows=block_rows,
                     flop_caps=flop_caps, out_caps=out_caps, skip=skip,
                     backend="scatter", chunk_w=WINDOWED_CHUNK_W,
+                    ring=RING, pipeline=PIPELINE,
                 )
 
         C, ov = mult(A)  # warmup/compile
@@ -261,8 +346,9 @@ def main():
         dt = time.perf_counter() - t0
         out = {
             "metric": (
-                f"spgemm_AxA_rmat_scale{SCALE}{_EFTAG}_{KERNEL}"
-                f"{'dot' if backend == 'dot' else ''}_MFLOPs"
+                f"spgemm_AxA_{PATTERN}_scale{SCALE}{_EFTAG}{_GRIDTAG}"
+                f"_{KERNEL}{'dot' if backend == 'dot' else ''}"
+                f"{_RINGTAG}_MFLOPs"
             ),
             "value": round(flops * 2 * REPS / dt / 1e6, 2),
             "unit": "MFLOP/s",
@@ -271,6 +357,9 @@ def main():
             "out_nnz": nnz_v,
             "overflow": int(jax.device_get(ov)),
             "tier": tier,
+            "grid": f"{grid.pr}x{grid.pc}",
+            "ring": RING,
+            "pipeline": PIPELINE,
             "block_rows": block_rows,
             "blocks": len(skip),
             "windows_skipped": (
@@ -285,13 +374,22 @@ def main():
             # same golden the ESC path reproduces (MultTest role).
             from scipy import sparse
 
-            rr, cc, vv = (
-                np.asarray(jax.device_get(x))[0, 0]
+            tr, tc_, tv = (
+                np.asarray(jax.device_get(x))
                 for x in (C.rows, C.cols, C.vals)
             )
-            live = rr < n
+            lr_, lc_ = C.local_rows, C.local_cols
+            gr_, gc_, gv_ = [], [], []
+            for i in range(grid.pr):  # stitch every tile (PR > 1)
+                for j in range(grid.pc):
+                    live = tr[i, j] < lr_
+                    gr_.append(tr[i, j][live].astype(np.int64) + i * lr_)
+                    gc_.append(tc_[i, j][live].astype(np.int64) + j * lc_)
+                    gv_.append(tv[i, j][live])
             got = sparse.csr_matrix(
-                (vv[live], (rr[live], cc[live])), shape=(n, n)
+                (np.concatenate(gv_),
+                 (np.concatenate(gr_), np.concatenate(gc_))),
+                shape=(n, n),
             )
             got.sum_duplicates()
             S = sparse.csr_matrix(
@@ -390,7 +488,7 @@ def main():
         print(
             json.dumps(
                 {
-                    "metric": f"spgemm_AxA_rmat_scale{SCALE}{_EFTAG}_scanphased{PHASES}_MFLOPs",
+                    "metric": f"spgemm_AxA_{PATTERN}_scale{SCALE}{_EFTAG}_scanphased{PHASES}_MFLOPs",
                     "value": round(flops * 2 * REPS / dt / 1e6, 2),
                     "unit": "MFLOP/s",
                     "flops": int(flops),
@@ -459,8 +557,12 @@ def main():
     else:
 
         def mult(a):
+            # BENCH_RING=1: the carousel (neighbor-rotation) schedule —
+            # the pre-round-9 serial carousel is BENCH_KERNEL=esc with
+            # ring on the old commit; this one is now stage-pipelined
             return summa_spgemm(
-                PLUS_TIMES, a, a, flop_capacity=fcap, out_capacity=ocap
+                PLUS_TIMES, a, a, flop_capacity=fcap, out_capacity=ocap,
+                ring=RING,
             )
 
         @jax.jit
@@ -481,7 +583,7 @@ def main():
         dt = time.perf_counter() - t0
         C = mult(A)
     out = {
-        "metric": f"spgemm_AxA_rmat_scale{SCALE}{_EFTAG}_{KERNEL}_MFLOPs",
+        "metric": f"spgemm_AxA_{PATTERN}_scale{SCALE}{_EFTAG}{_GRIDTAG}_{KERNEL}{_RINGTAG}_MFLOPs",
         "value": round(flops * 2 * REPS / dt / 1e6, 2),
         "unit": "MFLOP/s",
         "flops": int(flops),

@@ -116,6 +116,57 @@ name                            kind       meaning
 deadline drops (a request already expired when its batch reached the
 device is settled before occupying a lane), not just queue-sweep
 expiries.
+
+Pipelined / packed / 3D SpGEMM series (round 9 — the stage-pipelined
+windowed carousel, oracle-packed launches, and the windowed 3D tier;
+docs/spgemm.md):
+
+=======================================  =======  =====================
+name                                     kind     meaning
+=======================================  =======  =====================
+``spgemm.pipeline.stages_overlapped``    counter  TRACE-TIME: carousel
+                                                  stages whose
+                                                  successor rotation
+                                                  was issued before
+                                                  their accumulate
+                                                  (p−1 per compiled
+                                                  pipelined ring
+                                                  program; the jit
+                                                  retrace-visibility
+                                                  convention of the
+                                                  ``trace.*`` series)
+``spgemm.windowed.windows_packed``       counter  windows in the packed
+                                                  launch list — the
+                                                  MXU/scatter launches
+                                                  a plan actually pays
+                                                  (vs ``blocks`` ×
+                                                  ``col_windows``
+                                                  total)
+``spgemm.windowed.pack_ratio``           gauge    windows_packed /
+                                                  windows_total of the
+                                                  last plan (< 1 means
+                                                  the skip list or the
+                                                  oracle pruned
+                                                  launches)
+``spgemm.summa3d.layers``                gauge    L of the last 3D
+                                                  windowed product
+                                                  (``spgemm3d_windowed``
+                                                  / the ``windowed3d``
+                                                  auto route)
+``trace.summa3d_spgemm_windowed``        counter  3D windowed kernel
+                                                  (re)traces, labeled
+                                                  by accumulate
+                                                  ``backend``
+``trace.summa_spgemm_windowed``          counter  gains a ``ring``
+                                                  label (gathered vs
+                                                  carousel schedule)
+=======================================  =======  =====================
+
+Span events: the carousel body emits one ``spgemm.pipeline.stage``
+event per stage at trace time (fields ``stage``,
+``overlapped`` — whether the next rotation was issued early), so a
+trace export shows the planned comm/compute overlap structure of the
+compiled schedule.
 """
 
 from __future__ import annotations

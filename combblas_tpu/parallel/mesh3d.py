@@ -40,6 +40,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .. import obs
 from ..ops.compressed import CSR
 from ..ops.spgemm import expand as esc_expand
 from ..ops.tuples import SpTuples
@@ -414,6 +415,51 @@ def mem_efficient_spgemm3d(
     return SpParMat3D.col_concatenate(outs)
 
 
+def _fiber_exchange(partial_c: SpTuples, L: int, w_out: int,
+                    piece_capacity: int):
+    """Fiber exchange of one layer's partial product: split its local
+    cols into L pieces of width ``w_out`` (rebased to piece-local
+    columns), ``all_to_all`` them over the layer axis, and stitch the
+    received pieces into one [nrows × w_out] merge input.  The fiber
+    Alltoallv of ``ParFriends.h:3119-3180``, shared by the ESC and
+    windowed 3D kernels.  Returns (merged tuples, piece overflow — the
+    max count of entries a piece had to DROP to fit
+    ``piece_capacity``; zero means the exchange was lossless)."""
+    lr = partial_c.nrows
+    piece_arrays = []
+    worst = jnp.int32(0)
+    for l_ in range(L):
+        lo = l_ * w_out
+        keep = (
+            partial_c.valid_mask()
+            & (partial_c.cols >= lo)
+            & (partial_c.cols < lo + w_out)
+        )
+        nkeep = jnp.sum(keep).astype(jnp.int32)
+        worst = jnp.maximum(worst, nkeep - piece_capacity)
+        sel = partial_c._select(keep).with_capacity(piece_capacity)
+        cols = jnp.where(sel.valid_mask(), sel.cols - lo, w_out)
+        piece_arrays.append((sel.rows, cols, sel.vals, sel.nnz))
+
+    stacked = tuple(
+        jnp.stack([pa[k] for pa in piece_arrays])
+        for k in range(4)
+    )  # each [L, piece_capacity] / [L]
+    received = tuple(
+        lax.all_to_all(x, LAYER_AXIS, split_axis=0, concat_axis=0)
+        for x in stacked
+    )
+    merged = SpTuples(
+        rows=received[0].reshape(-1),
+        cols=received[1].reshape(-1),
+        vals=received[2].reshape(-1),
+        nnz=jnp.sum(received[3]).astype(jnp.int32),
+        nrows=lr,
+        ncols=w_out,
+    )
+    return merged, worst
+
+
 @partial(
     jax.jit,
     static_argnames=("sr", "flop_capacity", "out_capacity", "piece_capacity"),
@@ -464,37 +510,7 @@ def summa3d_spgemm(
             for s in range(p)
         ]
         partial_c = SpTuples.concat(chunks)  # [lr × lcB] partial, uncompacted
-
-        # Fiber exchange: split local cols into L pieces of width w_out
-        # (the 2D col_split pattern, rebased into piece-local columns).
-        piece_arrays = []
-        for l_ in range(L):
-            lo = l_ * w_out
-            keep = (
-                partial_c.valid_mask()
-                & (partial_c.cols >= lo)
-                & (partial_c.cols < lo + w_out)
-            )
-            sel = partial_c._select(keep).with_capacity(piece_capacity)
-            cols = jnp.where(sel.valid_mask(), sel.cols - lo, w_out)
-            piece_arrays.append((sel.rows, cols, sel.vals, sel.nnz))
-
-        stacked = tuple(
-            jnp.stack([pa[k] for pa in piece_arrays])
-            for k in range(4)
-        )  # each [L, piece_capacity] / [L]
-        received = tuple(
-            lax.all_to_all(x, LAYER_AXIS, split_axis=0, concat_axis=0)
-            for x in stacked
-        )
-        merged = SpTuples(
-            rows=received[0].reshape(-1),
-            cols=received[1].reshape(-1),
-            vals=received[2].reshape(-1),
-            nnz=jnp.sum(received[3]).astype(jnp.int32),
-            nrows=lr,
-            ncols=w_out,
-        )
+        merged, _ = _fiber_exchange(partial_c, L, w_out, piece_capacity)
         out = merged.compact(sr, capacity=out_capacity)
         return (
             out.rows[None, None, None], out.cols[None, None, None],
@@ -567,16 +583,464 @@ def summa3d_stage_flops(A: SpParMat3D, B: SpParMat3D) -> Array:
     )(A.rows, A.cols, B.rows)
 
 
-def spgemm3d(
-    sr: Semiring, A: SpParMat3D, B: SpParMat3D, slack: float = 1.05
-) -> SpParMat3D:
-    """Unjitted entry: distributed symbolic sizing → compiled
-    ``summa3d_spgemm``.
+# --- windowed 3D SUMMA (the round-9 tier: per-layer dense window
+# accumulators on the 3-axis mesh, ParFriends.h:2919-3213 with the
+# windowed local kernel in place of the hash SpGEMM) -------------------------
 
-    The sizing pass mirrors ``EstPerProcessNnzSUMMA``'s role
-    (ParFriends.h:1243); capacities round to powers of two (clamped to the
-    dense-tile bound) for compile-cache reuse.
+
+@partial(
+    jax.jit, static_argnames=("block_rows", "block_cols", "chunk_w")
+)
+def summa3d_window_flops_pair(
+    A3: SpParMat3D, B3: SpParMat3D, block_rows: int, block_cols: int,
+    chunk_w: int = 1,
+) -> Array:
+    """[2, L, nblocks, ncolwin, p, pr, pc]: the 3D-resolved windowed
+    symbolic pass — per-LAYER flop counts per (A row block, B col
+    window) per stage per tile, same (chunk-padded, true) pair contract
+    as the 2D ``summa_window_flops_pair`` (whose per-stage inner kernel
+    it shares)."""
+    from .spgemm import _window_stage_symbolic
+
+    assert A3.split == "col" and B3.split == "row"
+    assert A3.grid == B3.grid and A3.ncols == B3.nrows
+    grid = A3.grid
+    p = grid.pr
+    assert grid.pr == grid.pc, "SUMMA3D requires square layer grids"
+    lrA = A3.tile_rows
+    lrB, lcB = B3.tile_rows, B3.tile_cols
+    assert A3.tile_cols == lrB, "contraction blocking mismatch"
+    nblocks = -(-lrA // block_rows)
+    ncw = -(-lcB // block_cols)
+
+    def body(ar, ac, br, bc):
+        a_rows, a_cols = ar[0, 0, 0], ac[0, 0, 0]
+        b_rows, b_cols = br[0, 0, 0], bc[0, 0, 0]
+        ag_rows = lax.all_gather(a_rows, COL_AXIS)
+        ag_cols = lax.all_gather(a_cols, COL_AXIS)
+        bg_rows = lax.all_gather(b_rows, ROW_AXIS)
+        bg_cols = lax.all_gather(b_cols, ROW_AXIS)
+        per_stage = [
+            _window_stage_symbolic(
+                ag_rows[s], ag_cols[s], bg_rows[s], bg_cols[s],
+                lrA, lrB, block_rows, block_cols, nblocks, ncw, chunk_w,
+            )
+            for s in range(p)
+        ]
+        mine = jnp.stack(per_stage)  # [p, 2, nblocks, ncw]
+        g2 = lax.all_gather(
+            lax.all_gather(lax.all_gather(mine, COL_AXIS), ROW_AXIS),
+            LAYER_AXIS,
+        )  # [L, pr, pc, p, 2, nblocks, ncw]
+        # -> [2, L, nblocks, ncw, p, pr, pc]
+        return jnp.transpose(g2, (4, 0, 5, 6, 3, 1, 2))
+
+    return jax.shard_map(
+        body,
+        mesh=grid.mesh,
+        in_specs=(TILE3_SPEC,) * 4,
+        out_specs=P(),
+        check_vma=False,
+    )(A3.rows, A3.cols, B3.rows, B3.cols)
+
+
+def summa3d_window_flops_host(
+    grid3: Grid3D, rows_a, cols_a, rows_b, cols_b,
+    nrows_a: int, ncols_a: int, ncols_b: int,
+    block_rows: int, block_cols: int, chunk_w: int = 0,
+) -> np.ndarray:
+    """Host-numpy twin of ``summa3d_window_flops_pair`` (one chunk_w at
+    a time): [L, nblocks, ncolwin, p, pr, pc] float64 from global COO
+    arrays, zero device interaction — the axon-safe 3D sizing path."""
+    L = grid3.layers
+    p = grid3.pr
+    assert grid3.pr == grid3.pc, "SUMMA3D requires square layer grids"
+    lrA = grid3.local_rows(nrows_a)
+    lcA = grid3.local_cols(ncols_a)
+    lrB = grid3.local_rows(ncols_a)
+    lcB = grid3.local_cols(ncols_b)
+    assert lcA == lrB, "A col-blocking must equal B row-blocking"
+    assert lcA % L == 0 and lrB % L == 0, (lcA, lrB, L)
+    tcA = lcA // L  # A's per-layer contraction slice == B's trB
+    nb = -(-lrA // block_rows)
+    ncw = -(-lcB // block_cols)
+    rows_a = np.asarray(rows_a, np.int64)
+    cols_a = np.asarray(cols_a, np.int64)
+    rows_b = np.asarray(rows_b, np.int64)
+    cols_b = np.asarray(cols_b, np.int64)
+    ia, sa = rows_a // lrA, cols_a // lcA
+    la, ka = (cols_a % lcA) // tcA, (cols_a % lcA) % tcA
+    ga = (rows_a % lrA) // block_rows
+    countA = np.bincount(
+        ((((la * p + ia) * p + sa) * nb) + ga) * tcA + ka,
+        minlength=L * p * p * nb * tcA,
+    ).reshape(L, p, p, nb, tcA)
+    sb, jb = rows_b // lrB, cols_b // lcB
+    lb, kb = (rows_b % lrB) // tcA, (rows_b % lrB) % tcA
+    hb = (cols_b % lcB) // block_cols
+    countB = np.bincount(
+        ((((lb * p + sb) * p + jb) * ncw) + hb) * tcA + kb,
+        minlength=L * p * p * ncw * tcA,
+    ).reshape(L, p, p, ncw, tcA)
+    if chunk_w:
+        countB = -(-countB // chunk_w) * chunk_w
+    # flops[l, g, h, s, i, j] = sum_k countA[l,i,s,g,k]*countB[l,s,j,h,k]
+    return np.einsum(
+        "lisgk,lsjhk->lghsij",
+        countA.astype(np.float64), countB.astype(np.float64),
+    )
+
+
+@partial(jax.jit, static_argnames=("block_cols",))
+def summa3d_window_bnnz(B3: SpParMat3D, block_cols: int) -> Array:
+    """[L, pr, pc, ncolwin] int32, replicated: per-layer B-tile nnz per
+    col window — the 3D twin of ``summa_window_bnnz`` (the dot
+    backend's static panel slice capacity)."""
+    lrB, lcB = B3.tile_rows, B3.tile_cols
+    ncw = -(-lcB // block_cols)
+
+    def body(br, bc):
+        b_rows, b_cols = br[0, 0, 0], bc[0, 0, 0]
+        valid = b_rows < lrB
+        h = jnp.where(valid, b_cols // block_cols, ncw).astype(jnp.int32)
+        mine = jax.ops.segment_sum(
+            valid.astype(jnp.int32), h, num_segments=ncw + 1
+        )[:ncw]
+        return lax.all_gather(
+            lax.all_gather(lax.all_gather(mine, COL_AXIS), ROW_AXIS),
+            LAYER_AXIS,
+        )  # [L, pr, pc, ncw]
+
+    return jax.shard_map(
+        body,
+        mesh=B3.grid.mesh,
+        in_specs=(TILE3_SPEC,) * 2,
+        out_specs=P(),
+        check_vma=False,
+    )(B3.rows, B3.cols)
+
+
+def summa3d_window_bnnz_host(
+    grid3: Grid3D, rows_b, cols_b, ncols_a: int, ncols_b: int,
+    block_cols: int,
+) -> np.ndarray:
+    """Host twin of ``summa3d_window_bnnz``: [L, pr, pc, ncolwin]."""
+    L = grid3.layers
+    lrB = grid3.local_rows(ncols_a)
+    lcB = grid3.local_cols(ncols_b)
+    trB = lrB // L
+    ncw = -(-lcB // block_cols)
+    rows_b = np.asarray(rows_b, np.int64)
+    cols_b = np.asarray(cols_b, np.int64)
+    sb, jb = rows_b // lrB, cols_b // lcB
+    lb = (rows_b % lrB) // trB
+    hb = (cols_b % lcB) // block_cols
+    return np.bincount(
+        (((lb * grid3.pr + sb) * grid3.pc + jb) * ncw) + hb,
+        minlength=L * grid3.pr * grid3.pc * ncw,
+    ).reshape(L, grid3.pr, grid3.pc, ncw)
+
+
+def windowed_plan3d(
+    per_window_padded: np.ndarray | None,
+    per_window_true: np.ndarray,
+    block_rows: int,
+    block_cols: int,
+    tile_rows: int,
+    tile_cols_b: int,
+    slack: float = 1.02,
+) -> tuple[tuple, tuple, tuple]:
+    """3D twin of ``windowed_plan_2d`` over [L, nb, ncw, p, pr, pc]
+    counts: ONE SPMD program runs on every layer, so each window's caps
+    are the MAX over layers and a window is skipped only when EVERY
+    layer's symbolic count is zero.  Folding the layer axis into the
+    tile axes makes this exactly the 2D plan rule."""
+    from .spgemm import windowed_plan_2d
+
+    def fold(x):
+        if x is None:
+            return None
+        x = np.asarray(x, np.float64)
+        return np.moveaxis(x, 0, 3)  # [nb, ncw, p, L, pr, pc]
+
+    return windowed_plan_2d(
+        fold(per_window_padded), fold(per_window_true),
+        block_rows, block_cols, tile_rows, tile_cols_b, slack=slack,
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "sr", "block_rows", "flop_caps", "out_caps", "skip", "backend",
+        "mode", "chunk_w", "interpret", "block_cols", "panel_cap",
+        "piece_capacity", "out_capacity",
+    ),
+)
+def summa3d_spgemm_windowed(
+    sr: Semiring,
+    A3: SpParMat3D,
+    B3: SpParMat3D,
+    *,
+    block_rows: int,
+    flop_caps: tuple,
+    out_caps: tuple,
+    skip: tuple,
+    backend: str = "scatter",
+    mode: str = "f32",
+    chunk_w: int = 8,
+    interpret: bool = False,
+    block_cols: int | None = None,
+    panel_cap: int | None = None,
+    piece_capacity: int,
+    out_capacity: int,
+) -> tuple[SpParMat3D, Array]:
+    """C (col-split) = A (col-split) ⊗ B (row-split): the WINDOWED 3D
+    SUMMA — ``Mult_AnXBn_SUMMA3D`` with the sort-free windowed local
+    kernel in place of the per-stage ESC expand.
+
+    Each layer runs the per-device windowed accumulate+extract core of
+    the 2D tier (``spgemm._windowed_gathered_compute`` — both backends,
+    duplicate-safe ``densify_combine``, packed launch list, per-window
+    symbolic caps sized by ``windowed_plan3d`` over the layer slices),
+    producing one sparse [tile_rows × tile_cols] partial per layer; the
+    L partials then ride the fiber ``all_to_all`` (``_fiber_exchange``)
+    and a compacting merge, exactly like the ESC 3D kernel.  The payoff
+    mirrors the reference's 3DSpGEMM: per-layer stage operands carry
+    1/L of the contraction, so per-stage gather volume shrinks L-fold
+    where the 2D carousel saturates.
+
+    Returns (C, overflow): max over devices of (extraction overflow,
+    fiber piece drop, merge distinct-keys − out_capacity) — zero means
+    exact (and with symbolic caps the first two are structurally ≤ 0).
     """
+    from .spgemm import (
+        _PALLAS_KINDS,
+        _gather_stage_tiles,
+        _windowed_gathered_compute,
+    )
+    from ..ops.spgemm import scatter_combine_for
+
+    assert A3.split == "col" and B3.split == "row"
+    assert A3.grid == B3.grid and A3.ncols == B3.nrows
+    grid = A3.grid
+    p = grid.pr
+    assert grid.pr == grid.pc, "SUMMA3D requires square layer grids"
+    L = grid.layers
+    lr = A3.tile_rows  # full local rows of C
+    lrB, lcB = B3.tile_rows, B3.tile_cols
+    assert A3.tile_cols == lrB, "contraction blocking mismatch"
+    assert lcB % L == 0
+    w_out = lcB // L
+    two_d = backend == "dot" and block_cols is not None
+    if backend == "dot":
+        assert sr.name in _PALLAS_KINDS, sr.name
+        if two_d:
+            assert panel_cap is not None and panel_cap >= 1
+    else:
+        assert backend == "scatter", backend
+    assert scatter_combine_for(sr) is not None, sr.name
+    if obs.ENABLED:
+        obs.count(
+            "trace.summa3d_spgemm_windowed",
+            backend=("dot2d" if two_d else backend),
+        )
+    zero = float(np.asarray(sr.zero_fn(A3.vals.dtype)))
+    static = dict(
+        lrA=lr, lrB=lrB, lcB=lcB, block_rows=block_rows,
+        flop_caps=flop_caps, out_caps=out_caps, skip=skip,
+        backend=backend, mode=mode, chunk_w=chunk_w,
+        interpret=interpret, block_cols=block_cols if two_d else None,
+        panel_cap=panel_cap, zero=zero, dtype=A3.vals.dtype,
+    )
+
+    def body(ar, ac, av, an, br, bc, bv, bn):
+        a_mine = A3.local_tile(ar, ac, av, an)
+        b_mine = B3.local_tile(br, bc, bv, bn)
+        a_stages = _gather_stage_tiles(a_mine, COL_AXIS, p)
+        b_stages = _gather_stage_tiles(b_mine, ROW_AXIS, p)
+        chunks, worst = _windowed_gathered_compute(
+            sr, a_stages, b_stages, **static
+        )
+        if not chunks:  # every window skipped on this layer
+            chunks.append(SpTuples.empty(lr, lcB, 1, A3.vals.dtype))
+        partial_c = SpTuples.concat(chunks)
+        merged, piece_over = _fiber_exchange(
+            partial_c, L, w_out, piece_capacity
+        )
+        out, distinct = merged.compact_counted(sr, capacity=out_capacity)
+        worst = jnp.maximum(
+            jnp.maximum(worst, piece_over), distinct - out_capacity
+        )
+        worst = lax.pmax(
+            lax.pmax(lax.pmax(worst, ROW_AXIS), COL_AXIS), LAYER_AXIS
+        )
+        return (
+            out.rows[None, None, None], out.cols[None, None, None],
+            out.vals[None, None, None], out.nnz[None, None, None],
+            worst[None, None, None],
+        )
+
+    r, c, v, n, overflow = jax.shard_map(
+        body,
+        mesh=grid.mesh,
+        in_specs=(TILE3_SPEC,) * 8,
+        out_specs=(TILE3_SPEC,) * 5,
+        check_vma=False,
+    )(A3.rows, A3.cols, A3.vals, A3.nnz, B3.rows, B3.cols, B3.vals, B3.nnz)
+    mat = SpParMat3D(
+        rows=r, cols=c, vals=v, nnz=n,
+        nrows=A3.nrows, ncols=B3.ncols, split="col", grid=grid,
+    )
+    return mat, overflow[0, 0, 0]
+
+
+def summa3d_compatible(grid3: Grid3D, nrows_a: int, ncols_a: int,
+                       ncols_b: int) -> bool:
+    """True iff (A: nrows_a × ncols_a) ⊗ (B: ncols_a × ncols_b) can be
+    laid out on ``grid3`` (square layer grid; the col-split of A, the
+    row-split of B, and C's fiber pieces all divide evenly over the
+    layers) — the router's gate before choosing the 3D path."""
+    L = grid3.layers
+    if grid3.pr != grid3.pc:
+        return False
+    lcA = grid3.local_cols(ncols_a)
+    lrB = grid3.local_rows(ncols_a)
+    lcB = grid3.local_cols(ncols_b)
+    return (
+        lcA == lrB
+        and lcA % L == 0
+        and lrB % L == 0
+        and lcB % L == 0
+    )
+
+
+def spgemm3d_windowed(
+    sr: Semiring,
+    A3: SpParMat3D,
+    B3: SpParMat3D,
+    *,
+    block_rows: int | None = None,
+    block_cols: int | None = None,
+    backend: str | None = None,
+    mode: str = "f32",
+    slack: float = 1.02,
+    interpret: bool = False,
+) -> SpParMat3D:
+    """Sized entry for the windowed 3D tier: 3D symbolic pass →
+    ``windowed_plan3d`` (caps maxed over layers) → the compiled
+    ``summa3d_spgemm_windowed``.  Both accumulate backends; benchmarks
+    on readback-poisoned hardware size on host via
+    ``summa3d_window_flops_host`` + ``summa3d_window_bnnz_host`` and
+    call the kernel directly."""
+    from .spgemm import (
+        WINDOWED_CHUNK_W,
+        default_block_cols,
+        default_block_rows,
+        host_value,
+        packed_windows,
+        packed_windows_2d,
+        panel_cap_from_bnnz,
+        resolve_spgemm_backend,
+    )
+
+    backend = resolve_spgemm_backend(backend)
+    grid = A3.grid
+    L = grid.layers
+    lr = A3.tile_rows
+    lrB, lcB = B3.tile_rows, B3.tile_cols
+    chunk_w = WINDOWED_CHUNK_W
+    if block_rows is None:
+        block_rows = default_block_rows(lr, lcB)
+    if backend == "dot":
+        if block_cols is None:
+            block_cols = default_block_cols(lrB, lcB)
+        pair = host_value(
+            summa3d_window_flops_pair(A3, B3, block_rows, block_cols,
+                                      chunk_w=1)
+        )
+        flop_caps, out_caps, skip = windowed_plan3d(
+            None, pair[1], block_rows, block_cols, lr, lcB, slack=slack
+        )
+        panel_cap = panel_cap_from_bnnz(
+            host_value(summa3d_window_bnnz(B3, block_cols)),
+            int(B3.capacity),
+        )
+        npk = len(packed_windows_2d(skip))
+        ntot = sum(len(row) for row in skip)
+        per_block_bound = [sum(row) for row in out_caps]
+    else:
+        # scatter: the window pass with ONE full-width window gives the
+        # per-block (padded, true) pair in one kernel
+        pair = host_value(
+            summa3d_window_flops_pair(A3, B3, block_rows, lcB,
+                                      chunk_w=chunk_w)
+        )
+        fc2, oc2, sk2 = windowed_plan3d(
+            pair[0], pair[1], block_rows, lcB, lr, lcB, slack=slack
+        )
+        flop_caps = tuple(row[0] for row in fc2)
+        out_caps = tuple(row[0] for row in oc2)
+        skip = tuple(row[0] for row in sk2)
+        block_cols = panel_cap = None
+        npk = len(packed_windows(skip))
+        ntot = len(skip)
+        per_block_bound = list(out_caps)
+    if obs.ENABLED:
+        obs.gauge("spgemm.summa3d.layers", L)
+        obs.count("spgemm.windowed.windows_packed", npk)
+        obs.gauge(
+            "spgemm.windowed.pack_ratio", npk / ntot if ntot else 0.0
+        )
+    # fiber piece / merge capacities from the same symbolic bounds: one
+    # outgoing piece can hold at most the tile's whole extracted
+    # partial; the merge receives L pieces and compacts to at most the
+    # dense piece
+    rnd = lambda x: 1 << (max(int(x), 1) - 1).bit_length()
+    piece_cap = rnd(min(sum(per_block_bound), lr * lcB))
+    out_cap = min(rnd(piece_cap * L), max(lr * (lcB // L), 1))
+    C, overflow = summa3d_spgemm_windowed(
+        sr, A3, B3, block_rows=block_rows, flop_caps=flop_caps,
+        out_caps=out_caps, skip=skip, backend=backend, mode=mode,
+        chunk_w=chunk_w, interpret=interpret, block_cols=block_cols,
+        panel_cap=panel_cap, piece_capacity=piece_cap,
+        out_capacity=out_cap,
+    )
+    over = int(np.asarray(host_value(overflow)))
+    assert over <= 0, (
+        f"windowed 3D tier overflowed its symbolic bound by {over}"
+    )
+    return C
+
+
+def spgemm3d(
+    sr: Semiring, A: SpParMat3D, B: SpParMat3D, slack: float = 1.05,
+    *, tier: str | None = None, backend: str | None = None,
+    mode: str = "f32", block_rows: int | None = None,
+    block_cols: int | None = None, interpret: bool = False,
+) -> SpParMat3D:
+    """Unjitted entry: distributed symbolic sizing → compiled 3D SUMMA.
+
+    ``tier`` picks the per-layer local kernel: ``"esc"`` (default — the
+    classic expand/sort/compress stage kernel, exact for every
+    semiring) or ``"windowed"`` (the sort-free dense-window tier,
+    ``spgemm3d_windowed``); env ``COMBBLAS_SPGEMM3D_TIER`` overrides
+    when no argument is given.  The ESC sizing pass mirrors
+    ``EstPerProcessNnzSUMMA``'s role (ParFriends.h:1243); capacities
+    round to powers of two (clamped to the dense-tile bound) for
+    compile-cache reuse.
+    """
+    import os
+
+    if tier is None:
+        tier = os.environ.get("COMBBLAS_SPGEMM3D_TIER") or "esc"
+    assert tier in ("esc", "windowed"), tier
+    if tier == "windowed":
+        return spgemm3d_windowed(
+            sr, A, B, block_rows=block_rows, block_cols=block_cols,
+            backend=backend, mode=mode, slack=max(slack - 0.03, 1.02),
+            interpret=interpret,
+        )
     grid = A.grid
     L = grid.layers
     from .spgemm import host_value

@@ -84,6 +84,86 @@ def _gather_stage_tiles(t: SpTuples, axis_name, p: int) -> list[SpTuples]:
     ]
 
 
+def _carousel_perms(p: int):
+    """Cannon-carousel permutation tables over the joint (row, col) axis:
+    (skew_a, skew_b, rot_a, rot_b).  Pre-skew puts A_{i,(i+j)%p} /
+    B_{(i+j)%p,j} on device (i, j) so both held tiles share the
+    contraction index k=(i+j+s)%p at stage s; the rotations shift A left
+    / B up one neighbor per stage (the ring schedule of the reference's
+    carousel, BitMapCarousel.h)."""
+    skew_a = [
+        (i * p + (i + j) % p, i * p + j)
+        for i in range(p) for j in range(p)
+    ]
+    skew_b = [
+        (((i + j) % p) * p + j, i * p + j)
+        for i in range(p) for j in range(p)
+    ]
+    rot_a = [
+        (i * p + (j + 1) % p, i * p + j)
+        for i in range(p) for j in range(p)
+    ]
+    rot_b = [
+        (((i + 1) % p) * p + j, i * p + j)
+        for i in range(p) for j in range(p)
+    ]
+    return skew_a, skew_b, rot_a, rot_b
+
+
+def _rotate_tiles(t: SpTuples, perm) -> SpTuples:
+    """One carousel hop: ``ppermute`` all four tile arrays over the joint
+    (row, col) mesh axis.  Shared by the ESC, scan, and windowed carousel
+    paths (this used to be duplicated as a local ``joint_permute`` in
+    each ring kernel)."""
+    return SpTuples(
+        rows=lax.ppermute(t.rows, (ROW_AXIS, COL_AXIS), perm),
+        cols=lax.ppermute(t.cols, (ROW_AXIS, COL_AXIS), perm),
+        vals=lax.ppermute(t.vals, (ROW_AXIS, COL_AXIS), perm),
+        nnz=lax.ppermute(t.nnz, (ROW_AXIS, COL_AXIS), perm),
+        nrows=t.nrows, ncols=t.ncols,
+    )
+
+
+def _chain_tiles(t: SpTuples, dep) -> SpTuples:
+    """Pin a schedule dependency: the returned tile's arrays cannot be
+    consumed — so the NEXT rotation cannot be issued — before ``dep``
+    (an array from the current stage's accumulate) has been computed.
+    This is the explicit rotate→compute→rotate serial chain of the
+    UNPIPELINED carousel, kept as the measurement control
+    (``pipeline=False``); the pipelined schedule never calls this, so
+    its next-stage ``ppermute`` is free to overlap the current stage's
+    compute."""
+    rows, cols, vals, nnz, _ = lax.optimization_barrier(
+        (t.rows, t.cols, t.vals, t.nnz, dep)
+    )
+    return dataclasses.replace(t, rows=rows, cols=cols, vals=vals, nnz=nnz)
+
+
+def _carousel_stages(a_mine: SpTuples, b_mine: SpTuples, p: int):
+    """Generator driving the STAGE-PIPELINED carousel schedule: yields
+    ``(s, a_stage, b_stage)`` for each of the ``p`` stages with the
+    operands held in TWO-SLOT buffers.  The rotation producing stage
+    ``s+1``'s tiles is issued BEFORE stage ``s``'s tiles are consumed
+    (the yield), so XLA's latency-hiding scheduler can overlap the
+    neighbor ICI traffic with the stage's accumulate.  A serial
+    (unpipelined) control needs more than trace order — the rotation
+    must be PINNED behind the accumulate with ``_chain_tiles``, which
+    needs a stage-output array and so lives in the kernel's own loop
+    (see ``_windowed_carousel_compute``); the ESC/scan rings using this
+    generator are always pipelined."""
+    skew_a, skew_b, rot_a, rot_b = _carousel_perms(p)
+    a_cur = _rotate_tiles(a_mine, skew_a)
+    b_cur = _rotate_tiles(b_mine, skew_b)
+    for s in range(p):
+        a_nxt = b_nxt = None
+        if s != p - 1:
+            a_nxt = _rotate_tiles(a_cur, rot_a)
+            b_nxt = _rotate_tiles(b_cur, rot_b)
+        yield s, a_cur, b_cur
+        if s != p - 1:
+            a_cur, b_cur = a_nxt, b_nxt
+
+
 @partial(
     jax.jit,
     static_argnames=("sr", "flop_capacity", "out_capacity", "ring"),
@@ -103,12 +183,14 @@ def summa_spgemm(
     ``out_capacity`` bounds the final per-tile nnz.
     """
     _check_compat(A, B)
+    grid = A.grid
+    p = grid.pr
     if obs.ENABLED:
         # trace-time only (this fn is jitted): counts (re)traces per
         # static config, never executions — the jit retrace visibility
         obs.count("trace.summa_spgemm", ring=ring)
-    grid = A.grid
-    p = grid.pr
+        if ring and p > 1:
+            obs.count("spgemm.pipeline.stages_overlapped", p - 1)
 
     def body(ar, ac, av, an, br, bc, bv, bn):
         # stitch local tiles
@@ -128,44 +210,13 @@ def summa_spgemm(
                 chunks.append(stage_output(a_stages[s], b_stages[s]))
         else:
             # Cannon's algorithm: O(capacity) peak memory instead of
-            # O(p·capacity). Pre-skew with one joint-axis ppermute so device
-            # (i,j) starts with A_{i,(i+j)%p} and B_{(i+j)%p,j} — at stage s
-            # both held tiles share the contraction index k=(i+j+s)%p — then
-            # rotate A left / B up one step per stage (neighbor-only ICI
-            # traffic, the ring schedule of the reference's carousel,
-            # BitMapCarousel.h).
-            def joint_permute(t: SpTuples, perm) -> SpTuples:
-                return SpTuples(
-                    rows=lax.ppermute(t.rows, (ROW_AXIS, COL_AXIS), perm),
-                    cols=lax.ppermute(t.cols, (ROW_AXIS, COL_AXIS), perm),
-                    vals=lax.ppermute(t.vals, (ROW_AXIS, COL_AXIS), perm),
-                    nnz=lax.ppermute(t.nnz, (ROW_AXIS, COL_AXIS), perm),
-                    nrows=t.nrows, ncols=t.ncols,
-                )
-
-            skew_a = [
-                (i * p + (i + j) % p, i * p + j)
-                for i in range(p) for j in range(p)
-            ]
-            skew_b = [
-                (((i + j) % p) * p + j, i * p + j)
-                for i in range(p) for j in range(p)
-            ]
-            rot_a = [
-                (i * p + (j + 1) % p, i * p + j)
-                for i in range(p) for j in range(p)
-            ]
-            rot_b = [
-                (((i + 1) % p) * p + j, i * p + j)
-                for i in range(p) for j in range(p)
-            ]
-            a_cur = joint_permute(a_mine, skew_a)
-            b_cur = joint_permute(b_mine, skew_b)
-            for s in range(p):
+            # O(p·capacity), STAGE-PIPELINED — ``_carousel_stages``
+            # issues the ppermute producing stage s+1's tiles before
+            # stage s's tiles are consumed (two-slot operand buffers),
+            # so the neighbor ICI rotation overlaps the local expand
+            # instead of the old rotate→compute→rotate serial chain.
+            for s, a_cur, b_cur in _carousel_stages(a_mine, b_mine, p):
                 chunks.append(stage_output(a_cur, b_cur))
-                if s != p - 1:
-                    a_cur = joint_permute(a_cur, rot_a)
-                    b_cur = joint_permute(b_cur, rot_b)
 
         merged = SpTuples.concat(chunks)
         out = merged.compact(sr, capacity=out_capacity)
@@ -461,6 +512,56 @@ def summa_rowblock_flops_host(
     )
 
 
+def _window_stage_symbolic(
+    a_rows_s, a_cols_s, b_rows_s, b_cols_s,
+    lrA: int, lrB: int, block_rows: int, block_cols: int,
+    nblocks: int, ncw: int, chunk_w: int,
+):
+    """One SUMMA stage's [2, nblocks, ncw] windowed symbolic counts
+    (index 0 chunk-padded, index 1 true) from the stage's gathered A/B
+    index arrays — the inner kernel of ``summa_window_flops_pair``,
+    shared with the per-layer 3D pass (``mesh3d.
+    summa3d_window_flops_pair``)."""
+    b_valid = b_rows_s < lrB
+    # per-(col-window, B-row) walk lengths; invalid entries fall in the
+    # ncw overflow bucket (a sentinel col == lcB would otherwise land in
+    # the last window when block_cols ∤ lcB)
+    h = jnp.where(
+        b_valid, b_cols_s // block_cols, ncw
+    ).astype(jnp.int32)
+    key = h * (lrB + 1) + jnp.minimum(b_rows_s, lrB)
+    blens2 = jax.ops.segment_sum(
+        b_valid.astype(jnp.int32), key,
+        num_segments=(ncw + 1) * (lrB + 1),
+    ).reshape(ncw + 1, lrB + 1)
+    a_valid = a_rows_s < lrA
+    k = jnp.minimum(a_cols_s, lrB)
+    g = jnp.where(a_valid, a_rows_s // block_rows, nblocks)
+    # chunk_w == 1 padding is the identity: run the inner gather+segment
+    # loop once and reuse it for both variants (the dot-backend sizing
+    # path never consumes the padded counts, so it requests chunk_w=1)
+    variants = (
+        (blens2,) if chunk_w == 1
+        else (-(-blens2 // chunk_w) * chunk_w, blens2)
+    )
+    both = []
+    for bl in variants:
+        per_h = []
+        for hh in range(ncw):  # static loop bounds memory to
+            per_entry = jnp.where(  # one [nnzA] gather per window
+                a_valid, bl[hh, k], 0
+            ).astype(jnp.float32)
+            per_h.append(
+                jax.ops.segment_sum(
+                    per_entry, g, num_segments=nblocks + 1
+                )[:nblocks]
+            )
+        both.append(jnp.stack(per_h, axis=1))  # [nblocks, ncw]
+    if len(both) == 1:
+        both = [both[0], both[0]]
+    return jnp.stack(both)  # [2, nblocks, ncw]
+
+
 @partial(
     jax.jit, static_argnames=("block_rows", "block_cols", "chunk_w")
 )
@@ -493,47 +594,13 @@ def summa_window_flops_pair(
         ag_cols = lax.all_gather(a_cols, COL_AXIS)
         bg_rows = lax.all_gather(b_rows, ROW_AXIS)
         bg_cols = lax.all_gather(b_cols, ROW_AXIS)
-        per_stage = []
-        for s in range(p):
-            b_valid = bg_rows[s] < lrB
-            # per-(col-window, B-row) walk lengths; invalid entries fall
-            # in the ncw overflow bucket (a sentinel col == lcB would
-            # otherwise land in the last window when block_cols ∤ lcB)
-            h = jnp.where(
-                b_valid, bg_cols[s] // block_cols, ncw
-            ).astype(jnp.int32)
-            key = h * (lrB + 1) + jnp.minimum(bg_rows[s], lrB)
-            blens2 = jax.ops.segment_sum(
-                b_valid.astype(jnp.int32), key,
-                num_segments=(ncw + 1) * (lrB + 1),
-            ).reshape(ncw + 1, lrB + 1)
-            a_valid = ag_rows[s] < lrA
-            k = jnp.minimum(ag_cols[s], lrB)
-            g = jnp.where(a_valid, ag_rows[s] // block_rows, nblocks)
-            # chunk_w == 1 padding is the identity: run the inner
-            # gather+segment loop once and reuse it for both variants
-            # (the dot-backend sizing path never consumes the padded
-            # counts, so it requests chunk_w=1)
-            variants = (
-                (blens2,) if chunk_w == 1
-                else (-(-blens2 // chunk_w) * chunk_w, blens2)
+        per_stage = [
+            _window_stage_symbolic(
+                ag_rows[s], ag_cols[s], bg_rows[s], bg_cols[s],
+                lrA, lrB, block_rows, block_cols, nblocks, ncw, chunk_w,
             )
-            both = []
-            for bl in variants:
-                per_h = []
-                for hh in range(ncw):  # static loop bounds memory to
-                    per_entry = jnp.where(  # one [nnzA] gather per window
-                        a_valid, bl[hh, k], 0
-                    ).astype(jnp.float32)
-                    per_h.append(
-                        jax.ops.segment_sum(
-                            per_entry, g, num_segments=nblocks + 1
-                        )[:nblocks]
-                    )
-                both.append(jnp.stack(per_h, axis=1))  # [nblocks, ncw]
-            if len(both) == 1:
-                both = [both[0], both[0]]
-            per_stage.append(jnp.stack(both))  # [2, nblocks, ncw]
+            for s in range(p)
+        ]
         mine = jnp.stack(per_stage)  # [p, 2, nblocks, ncw]
         g2 = lax.all_gather(lax.all_gather(mine, COL_AXIS), ROW_AXIS)
         # [pr, pc, p, 2, nblocks, ncw] -> [2, nblocks, ncw, p, pr, pc]
@@ -718,6 +785,71 @@ def windowed_plan(
     return tuple(flop_caps), tuple(out_caps), tuple(skip)
 
 
+def packed_windows(skip) -> tuple[int, ...]:
+    """1D skip list → dense LAUNCH LIST of occupied row blocks.
+
+    The kernels iterate this packed list instead of the full block grid
+    with per-block skip tests, so a sparse plan pays one launch per
+    OCCUPIED block — the trace-level contract the oracle seeding
+    tightens (`_oracle_out_caps_2d` turns flops-positive but
+    output-empty windows into skips, which packing then never visits).
+    """
+    return tuple(g for g, s in enumerate(skip) if not s)
+
+
+def packed_windows_2d(skip) -> tuple[tuple[int, int], ...]:
+    """2D skip list → packed launch list of occupied (row block, col
+    window) pairs, block-major then window-major — the kernels' output
+    chunk order, so a packed run and a skip-list run emit IDENTICAL
+    tiles."""
+    return tuple(
+        (g, h) for g, row in enumerate(skip)
+        for h, s in enumerate(row) if not s
+    )
+
+
+def _live_windows_by_block(skip) -> tuple:
+    """Packed 2D launch list grouped by row block:
+    ``((g, (h, ...)), ...)`` — blocks with no live window are absent
+    entirely (their A block is never masked or densified)."""
+    out = []
+    for g, row in enumerate(skip):
+        hs = tuple(h for h, s in enumerate(row) if not s)
+        if hs:
+            out.append((g, hs))
+    return tuple(out)
+
+
+def _extract_window_2d(acc, zero, lo, h, rb, block_cols, lrA, lcB, out_cap):
+    """One (row block, col window) extraction → (global-coord chunk,
+    overflow vs the symbolic bound).  Shared by the gathered and
+    carousel schedules (and the 3D per-layer kernel)."""
+    from ..ops.spgemm import sparsify_windowed
+
+    wc = min(block_cols, lcB - h * block_cols)
+    t_blk, total = sparsify_windowed(acc, zero, rb, wc, out_cap)
+    vm = t_blk.valid_mask()
+    chunk = SpTuples(
+        rows=jnp.where(vm, t_blk.rows + lo, lrA),
+        cols=jnp.where(vm, t_blk.cols + h * block_cols, lcB),
+        vals=t_blk.vals, nnz=t_blk.nnz, nrows=lrA, ncols=lcB,
+    )
+    return chunk, total - out_cap
+
+
+def _extract_block_1d(acc, zero, lo, rb, lrA, lcB, out_cap):
+    """One full-width row-block extraction → (chunk, overflow)."""
+    from ..ops.spgemm import sparsify_windowed
+
+    t_blk, total = sparsify_windowed(acc, zero, rb, lcB, out_cap)
+    rows = jnp.where(t_blk.valid_mask(), t_blk.rows + lo, lrA)
+    chunk = SpTuples(
+        rows=rows, cols=t_blk.cols, vals=t_blk.vals,
+        nnz=t_blk.nnz, nrows=lrA, ncols=lcB,
+    )
+    return chunk, total - out_cap
+
+
 def _shift_rowblock(am: SpTuples, lo, arows: int) -> SpTuples:
     """Row-block tile → block-local coordinates: valid rows shift down
     by ``lo``; invalid slots land EXACTLY at the new sentinel ``arows``
@@ -772,11 +904,269 @@ def _window_stage_product(
     )
 
 
+def _windowed_dims(backend: str, block_cols, lrB: int, lcB: int):
+    """Static padded dims of the windowed accumulate: (two_d, pcols, pk,
+    pwin)."""
+    two_d = backend == "dot" and block_cols is not None
+    if backend == "dot":
+        pcols = _pad128(lcB)
+        pk = _pad128(lrB)
+        pwin = _pad128(block_cols) if two_d else None
+    else:
+        pcols = -(-lcB // 128) * 128
+        pk = pwin = None
+    return two_d, pcols, pk, pwin
+
+
+def _windowed_stage_b_side(sr, b_stage, backend, two_d, pk, pcols,
+                           block_cols):
+    """Per-stage B-side preprocessing: CSR (scatter), dense tile (1D
+    dot), or (col-major sorted tile, window slot starts) (2D dot)."""
+    from ..ops.spgemm import densify_combine
+
+    if backend == "scatter":
+        return CSR.from_tuples(b_stage)
+    if not two_d:
+        return densify_combine(sr, b_stage, pk, pcols)
+    return _colmajor_with_starts(b_stage, block_cols)
+
+
+def _windowed_gathered_compute(
+    sr: Semiring, a_stages, b_stages, *, lrA, lrB, lcB, block_rows,
+    flop_caps, out_caps, skip, backend, mode, chunk_w, interpret,
+    block_cols, panel_cap, zero, dtype,
+):
+    """Block-outer windowed accumulate + extract over PRE-GATHERED stage
+    tiles — the per-device core of the gathered schedule, shared by the
+    2D shard_map kernel and the per-layer 3D kernel
+    (``mesh3d.summa3d_spgemm_windowed``).  Iterates the PACKED launch
+    list (``_live_windows_by_block`` / ``packed_windows``) so sparse
+    plans pay one accumulate+extract per occupied window.  Returns
+    (chunks, worst)."""
+    from ..ops.spgemm import (
+        accumulate_block_scatter,
+        densify_combine,
+        mask_rows,
+    )
+
+    p = len(a_stages)
+    kind = _PALLAS_KINDS.get(sr.name)
+    two_d, pcols, pk, pwin = _windowed_dims(backend, block_cols, lrB, lcB)
+    b_sides = [
+        _windowed_stage_b_side(sr, b, backend, two_d, pk, pcols, block_cols)
+        for b in b_stages
+    ]
+    chunks = []
+    worst = jnp.int32(0)
+    if two_d:
+        for g, hs in _live_windows_by_block(skip):
+            lo = g * block_rows
+            rb = min(block_rows, lrA - lo)
+            arows = _pad128(rb)
+            accs = {h: jnp.full((arows, pwin), zero, dtype) for h in hs}
+            for s in range(p):
+                am = mask_rows(a_stages[s], lo, lo + rb)
+                da = densify_combine(
+                    sr, _shift_rowblock(am, lo, arows), arows, pk
+                )
+                bs_sorted, b_starts = b_sides[s]
+                for h in hs:
+                    panel = _dense_col_panel(
+                        sr, bs_sorted, b_starts, h, block_cols, pk,
+                        pwin, panel_cap,
+                    )
+                    accs[h] = sr.add(
+                        accs[h],
+                        _window_stage_product(
+                            sr, kind, da, panel, mode, interpret
+                        ),
+                    )
+            for h in hs:
+                chunk, over = _extract_window_2d(
+                    accs[h], zero, lo, h, rb, block_cols, lrA, lcB,
+                    out_caps[g][h],
+                )
+                worst = jnp.maximum(worst, over)
+                chunks.append(chunk)
+        return chunks, worst
+    for g in packed_windows(skip):
+        lo = g * block_rows
+        rb = min(block_rows, lrA - lo)
+        arows = _pad128(rb) if backend == "dot" else rb
+        acc = jnp.full((arows, pcols), zero, dtype)
+        for s in range(p):
+            am = mask_rows(a_stages[s], lo, lo + rb)
+            if backend == "scatter":
+                acc = accumulate_block_scatter(
+                    sr, acc, am, b_sides[s], row_lo=lo,
+                    flop_capacity=max(flop_caps[g], chunk_w),
+                    chunk_w=chunk_w,
+                )
+            else:
+                da = densify_combine(
+                    sr, _shift_rowblock(am, lo, arows), arows, pk
+                )
+                acc = sr.add(
+                    acc,
+                    _window_stage_product(
+                        sr, kind, da, b_sides[s], mode, interpret
+                    ),
+                )
+        chunk, over = _extract_block_1d(
+            acc, zero, lo, rb, lrA, lcB, out_caps[g]
+        )
+        worst = jnp.maximum(worst, over)
+        chunks.append(chunk)
+    return chunks, worst
+
+
+def _windowed_carousel_compute(
+    sr: Semiring, a_mine, b_mine, *, p, lrA, lrB, lcB, block_rows,
+    flop_caps, out_caps, skip, backend, mode, chunk_w, interpret,
+    block_cols, panel_cap, zero, dtype, pipeline,
+):
+    """STAGE-OUTER carousel windowed accumulate + extract: the operands
+    live in two-slot neighbor-rotation buffers (O(2·tile) sparse memory
+    instead of the gathered schedule's O(p·tile)) and with
+    ``pipeline=True`` stage ``s+1``'s ``ppermute`` is issued BEFORE
+    stage ``s``'s tiles are consumed, so the ICI rotation overlaps the
+    MXU/scatter accumulate.  The trade: ALL live block/window
+    accumulators coexist across the stage loop (the gathered schedule
+    keeps one block live at a time) — callers pick this schedule where
+    the per-device dense tile is grid-divided small (the distributed
+    mid-scale regime it is built for).
+
+    ``pipeline=False`` is the measurement control: the rotation is
+    pinned BEHIND the stage's accumulate (``_chain_tiles``), the strict
+    rotate→compute→rotate serial chain."""
+    from ..ops.spgemm import (
+        accumulate_block_scatter,
+        densify_combine,
+        mask_rows,
+    )
+
+    kind = _PALLAS_KINDS.get(sr.name)
+    two_d, pcols, pk, pwin = _windowed_dims(backend, block_cols, lrB, lcB)
+
+    def block_geom(g):
+        lo = g * block_rows
+        rb = min(block_rows, lrA - lo)
+        arows = _pad128(rb) if backend == "dot" else rb
+        return lo, rb, arows
+
+    if two_d:
+        live = _live_windows_by_block(skip)
+        accs = {
+            (g, h): jnp.full((block_geom(g)[2], pwin), zero, dtype)
+            for g, hs in live for h in hs
+        }
+    else:
+        live = packed_windows(skip)
+        accs = {
+            g: jnp.full((block_geom(g)[2], pcols), zero, dtype)
+            for g in live
+        }
+    skew_a, skew_b, rot_a, rot_b = _carousel_perms(p)
+    a_cur = _rotate_tiles(a_mine, skew_a)
+    b_cur = _rotate_tiles(b_mine, skew_b)
+    for s in range(p):
+        a_nxt = b_nxt = None
+        overlapped = pipeline and s != p - 1
+        if overlapped:
+            a_nxt = _rotate_tiles(a_cur, rot_a)
+            b_nxt = _rotate_tiles(b_cur, rot_b)
+        if obs.ENABLED:
+            # trace-time schedule record: one event per carousel stage
+            # noting whether its successor rotation was issued early
+            obs.span_event(
+                "spgemm.pipeline.stage", stage=s,
+                overlapped=bool(overlapped),
+            )
+        b_side = _windowed_stage_b_side(
+            sr, b_cur, backend, two_d, pk, pcols, block_cols
+        )
+        if two_d:
+            bs_sorted, b_starts = b_side
+            for g, hs in live:
+                lo, rb, arows = block_geom(g)
+                am = mask_rows(a_cur, lo, lo + rb)
+                da = densify_combine(
+                    sr, _shift_rowblock(am, lo, arows), arows, pk
+                )
+                for h in hs:
+                    panel = _dense_col_panel(
+                        sr, bs_sorted, b_starts, h, block_cols, pk,
+                        pwin, panel_cap,
+                    )
+                    accs[(g, h)] = sr.add(
+                        accs[(g, h)],
+                        _window_stage_product(
+                            sr, kind, da, panel, mode, interpret
+                        ),
+                    )
+        else:
+            for g in live:
+                lo, rb, arows = block_geom(g)
+                am = mask_rows(a_cur, lo, lo + rb)
+                if backend == "scatter":
+                    accs[g] = accumulate_block_scatter(
+                        sr, accs[g], am, b_side, row_lo=lo,
+                        flop_capacity=max(flop_caps[g], chunk_w),
+                        chunk_w=chunk_w,
+                    )
+                else:
+                    da = densify_combine(
+                        sr, _shift_rowblock(am, lo, arows), arows, pk
+                    )
+                    accs[g] = sr.add(
+                        accs[g],
+                        _window_stage_product(
+                            sr, kind, da, b_side, mode, interpret
+                        ),
+                    )
+        if s != p - 1:
+            if not pipeline:
+                # serial-chain control: rotation waits for this stage's
+                # ENTIRE accumulate — every live accumulator, else XLA
+                # may overlap the rotation with the unpinned blocks and
+                # the control stops being serial
+                dep = (
+                    tuple(accs.values()) if accs else jnp.int32(0)
+                )
+                a_cur = _chain_tiles(a_cur, dep)
+                b_cur = _chain_tiles(b_cur, dep)
+                a_nxt = _rotate_tiles(a_cur, rot_a)
+                b_nxt = _rotate_tiles(b_cur, rot_b)
+            a_cur, b_cur = a_nxt, b_nxt
+    chunks = []
+    worst = jnp.int32(0)
+    if two_d:
+        for g, hs in live:
+            lo, rb, _ = block_geom(g)
+            for h in hs:
+                chunk, over = _extract_window_2d(
+                    accs[(g, h)], zero, lo, h, rb, block_cols, lrA,
+                    lcB, out_caps[g][h],
+                )
+                worst = jnp.maximum(worst, over)
+                chunks.append(chunk)
+        return chunks, worst
+    for g in live:
+        lo, rb, _ = block_geom(g)
+        chunk, over = _extract_block_1d(
+            accs[g], zero, lo, rb, lrA, lcB, out_caps[g]
+        )
+        worst = jnp.maximum(worst, over)
+        chunks.append(chunk)
+    return chunks, worst
+
+
 @partial(
     jax.jit,
     static_argnames=(
         "sr", "block_rows", "flop_caps", "out_caps", "skip", "backend",
         "mode", "chunk_w", "interpret", "block_cols", "panel_cap",
+        "ring", "pipeline",
     ),
 )
 def summa_spgemm_windowed(
@@ -794,6 +1184,8 @@ def summa_spgemm_windowed(
     interpret: bool = False,
     block_cols: int | None = None,
     panel_cap: int | None = None,
+    ring: bool = False,
+    pipeline: bool = True,
 ) -> tuple[SpParMat, jax.Array]:
     """Sort-free SUMMA over dense ROW-BLOCK accumulators — the mid-scale
     general sparse-output tier.
@@ -846,14 +1238,24 @@ def summa_spgemm_windowed(
     between blocks — ``valid_mask`` semantics, which every downstream
     consumer (to_dense, CSR/CSC builds, ewise, redistribute) honors;
     a global re-sort would reintroduce the cost this kernel removes.
+
+    SCHEDULES.  ``ring=False`` (default) is the GATHERED schedule: one
+    fused all_gather per operand stages all tiles up front, then a
+    block-outer loop keeps one dense accumulator live at a time (peak
+    sparse memory O(p·tile)).  ``ring=True`` is the STAGE-PIPELINED
+    CAROUSEL: operands rotate neighbor-to-neighbor in two-slot buffers
+    (peak sparse memory O(2·tile)) and with ``pipeline=True`` stage
+    s+1's ``ppermute`` is issued before stage s's tiles are consumed,
+    so the ICI rotation overlaps the accumulate — the van de Geijn &
+    Watts overlap the gathered schedule leaves to chance.  The carousel
+    keeps every live block/window accumulator alive across the stage
+    loop, so it fits where per-device tiles are grid-divided small (its
+    distributed target regime).  ``pipeline=False`` pins the strict
+    rotate→compute→rotate serial chain (the measurement control).
+    Both schedules iterate the PACKED launch list (``packed_windows`` /
+    ``packed_windows_2d``) and emit identical chunk layouts.
     """
-    from ..ops.spgemm import (
-        accumulate_block_scatter,
-        densify_combine,
-        mask_rows,
-        scatter_combine_for,
-        sparsify_windowed,
-    )
+    from ..ops.spgemm import scatter_combine_for
 
     _check_compat(A, B)
     grid = A.grid
@@ -875,122 +1277,46 @@ def summa_spgemm_windowed(
             f"got {sr.name}"
         )
         assert scatter_combine_for(sr) is not None, sr.name
-        pcols = _pad128(lcB)
-        pk = _pad128(lrB)
         if two_d:
             assert panel_cap is not None and panel_cap >= 1
             assert all(len(row) == ncw for row in skip), (ncw, skip)
-            pwin = _pad128(block_cols)
     else:
         assert backend == "scatter", backend
         assert scatter_combine_for(sr) is not None, (
             f"semiring {sr.name} has no scatter combiner; use the ESC "
             "path"
         )
-        pcols = -(-lcB // 128) * 128
     if obs.ENABLED:
         obs.count(
             "trace.summa_spgemm_windowed",
             backend=("dot2d" if two_d else backend),
+            ring=ring,
         )
+        if ring and pipeline and p > 1:
+            # trace-time: carousel stages whose successor rotation is
+            # issued early (overlappable) in this compiled program
+            obs.count("spgemm.pipeline.stages_overlapped", p - 1)
     zero = float(np.asarray(sr.zero_fn(A.vals.dtype)))
+    static = dict(
+        lrA=lrA, lrB=lrB, lcB=lcB, block_rows=block_rows,
+        flop_caps=flop_caps, out_caps=out_caps, skip=skip,
+        backend=backend, mode=mode, chunk_w=chunk_w,
+        interpret=interpret, block_cols=block_cols if two_d else None,
+        panel_cap=panel_cap, zero=zero, dtype=A.vals.dtype,
+    )
 
     def body(ar, ac, av, an, br, bc, bv, bn):
         a_mine = A.local_tile(ar, ac, av, an)
         b_mine = B.local_tile(br, bc, bv, bn)
-        a_stages = _gather_stage_tiles(a_mine, COL_AXIS, p)
-        b_stages = _gather_stage_tiles(b_mine, ROW_AXIS, p)
-        if backend == "scatter":
-            b_sides = [CSR.from_tuples(b_stages[s]) for s in range(p)]
-        elif not two_d:
-            b_sides = [
-                densify_combine(sr, b_stages[s], pk, pcols)
-                for s in range(p)
-            ]
-        else:
-            # col-major sort once per stage; each window's entries are
-            # then one contiguous slot range found by searchsorted
-            # (same preamble helper as the local fast path)
-            b_sorted, b_starts = zip(*(
-                _colmajor_with_starts(b_stages[s], block_cols)
-                for s in range(p)
-            ))
-        chunks = []
-        worst = jnp.int32(0)
-        for g in range(nblocks):
-            if (all(skip[g]) if two_d else skip[g]):
-                continue
-            lo = g * block_rows
-            rb = min(block_rows, lrA - lo)
-            arows = _pad128(rb) if backend == "dot" else rb
-            if two_d:
-                accs = {
-                    h: jnp.full((arows, pwin), zero, A.vals.dtype)
-                    for h in range(ncw) if not skip[g][h]
-                }
-                for s in range(p):
-                    am = mask_rows(a_stages[s], lo, lo + rb)
-                    da = densify_combine(
-                        sr, _shift_rowblock(am, lo, arows), arows, pk
-                    )
-                    for h in accs:
-                        panel = _dense_col_panel(
-                            sr, b_sorted[s], b_starts[s], h,
-                            block_cols, pk, pwin, panel_cap,
-                        )
-                        accs[h] = sr.add(
-                            accs[h],
-                            _window_stage_product(
-                                sr, kind, da, panel, mode, interpret
-                            ),
-                        )
-                for h, acc in accs.items():
-                    wc = min(block_cols, lcB - h * block_cols)
-                    t_blk, total = sparsify_windowed(
-                        acc, zero, rb, wc, out_caps[g][h]
-                    )
-                    worst = jnp.maximum(worst, total - out_caps[g][h])
-                    vm = t_blk.valid_mask()
-                    chunks.append(
-                        SpTuples(
-                            rows=jnp.where(vm, t_blk.rows + lo, lrA),
-                            cols=jnp.where(
-                                vm, t_blk.cols + h * block_cols, lcB
-                            ),
-                            vals=t_blk.vals, nnz=t_blk.nnz,
-                            nrows=lrA, ncols=lcB,
-                        )
-                    )
-                continue
-            acc = jnp.full((arows, pcols), zero, A.vals.dtype)
-            for s in range(p):
-                am = mask_rows(a_stages[s], lo, lo + rb)
-                if backend == "scatter":
-                    acc = accumulate_block_scatter(
-                        sr, acc, am, b_sides[s], row_lo=lo,
-                        flop_capacity=max(flop_caps[g], chunk_w),
-                        chunk_w=chunk_w,
-                    )
-                else:
-                    da = densify_combine(
-                        sr, _shift_rowblock(am, lo, arows), arows, pk
-                    )
-                    acc = sr.add(
-                        acc,
-                        _window_stage_product(
-                            sr, kind, da, b_sides[s], mode, interpret
-                        ),
-                    )
-            t_blk, total = sparsify_windowed(
-                acc, zero, rb, lcB, out_caps[g]
+        if ring:
+            chunks, worst = _windowed_carousel_compute(
+                sr, a_mine, b_mine, p=p, pipeline=pipeline, **static
             )
-            worst = jnp.maximum(worst, total - out_caps[g])
-            rows = jnp.where(t_blk.valid_mask(), t_blk.rows + lo, lrA)
-            chunks.append(
-                SpTuples(
-                    rows=rows, cols=t_blk.cols, vals=t_blk.vals,
-                    nnz=t_blk.nnz, nrows=lrA, ncols=lcB,
-                )
+        else:
+            a_stages = _gather_stage_tiles(a_mine, COL_AXIS, p)
+            b_stages = _gather_stage_tiles(b_mine, ROW_AXIS, p)
+            chunks, worst = _windowed_gathered_compute(
+                sr, a_stages, b_stages, **static
             )
         if not chunks:  # every block skipped: structurally empty output
             chunks.append(SpTuples.empty(lrA, lcB, 1, A.vals.dtype))
@@ -1289,10 +1615,12 @@ def summa_spgemm_scan(
     realized iteratively).
     """
     _check_compat(A, B)
-    if obs.ENABLED:
-        obs.count("trace.summa_spgemm_scan", ring=ring)
     grid = A.grid
     p = grid.pr
+    if obs.ENABLED:
+        obs.count("trace.summa_spgemm_scan", ring=ring)
+        if ring and p > 1:
+            obs.count("spgemm.pipeline.stages_overlapped", p - 1)
 
     def body(ar, ac, av, an, br, bc, bv, bn):
         a_mine = A.local_tile(ar, ac, av, an)
@@ -1316,38 +1644,11 @@ def summa_spgemm_scan(
             for s in range(p):
                 acc, worst = merge(acc, worst, a_stages[s], b_stages[s])
         else:
-            def joint_permute(t: SpTuples, perm) -> SpTuples:
-                return SpTuples(
-                    rows=lax.ppermute(t.rows, (ROW_AXIS, COL_AXIS), perm),
-                    cols=lax.ppermute(t.cols, (ROW_AXIS, COL_AXIS), perm),
-                    vals=lax.ppermute(t.vals, (ROW_AXIS, COL_AXIS), perm),
-                    nnz=lax.ppermute(t.nnz, (ROW_AXIS, COL_AXIS), perm),
-                    nrows=t.nrows, ncols=t.ncols,
-                )
-
-            skew_a = [
-                (i * p + (i + j) % p, i * p + j)
-                for i in range(p) for j in range(p)
-            ]
-            skew_b = [
-                (((i + j) % p) * p + j, i * p + j)
-                for i in range(p) for j in range(p)
-            ]
-            rot_a = [
-                (i * p + (j + 1) % p, i * p + j)
-                for i in range(p) for j in range(p)
-            ]
-            rot_b = [
-                (((i + 1) % p) * p + j, i * p + j)
-                for i in range(p) for j in range(p)
-            ]
-            a_cur = joint_permute(a_mine, skew_a)
-            b_cur = joint_permute(b_mine, skew_b)
-            for s in range(p):
+            # stage-pipelined carousel (shared two-slot schedule; see
+            # summa_spgemm's ring path): stage s+1's rotation is issued
+            # before stage s's expand+merge consumes the current tiles
+            for s, a_cur, b_cur in _carousel_stages(a_mine, b_mine, p):
                 acc, worst = merge(acc, worst, a_cur, b_cur)
-                if s != p - 1:
-                    a_cur = joint_permute(a_cur, rot_a)
-                    b_cur = joint_permute(b_cur, rot_b)
 
         worst = lax.pmax(lax.pmax(worst, ROW_AXIS), COL_AXIS)
         return SpParMat._pack_tile(acc) + (worst[None, None],)
@@ -1736,9 +2037,7 @@ def _windowed_block_local_dot(
     rows_l, cols_l, vals_l = [], [], []
     nnz = jnp.int32(0)
     worst = jnp.int32(0)
-    for h in range(len(skip_row)):
-        if skip_row[h]:
-            continue
+    for h in packed_windows(skip_row):  # packed launch list
         panel = _dense_col_panel(
             sr, bs, b_starts, h, block_cols, pk, pwin, panel_cap
         )
@@ -1848,6 +2147,141 @@ def local_spgemm_windowed(
     return mat, worst
 
 
+@partial(
+    jax.jit,
+    static_argnames=("sr", "rb", "flop_cap", "out_cap", "chunk_w"),
+)
+def _windowed_block_dist(
+    sr: Semiring,
+    A: SpParMat,
+    B: SpParMat,
+    lo,
+    *,
+    rb: int,
+    flop_cap: int,
+    out_cap: int,
+    chunk_w: int,
+):
+    """One row block of the BLOCKED-DISPATCH distributed windowed tier
+    (scatter backend): a self-contained shard_map program that gathers
+    the stage tiles, accumulates ONE dense row block, and extracts it.
+    ``lo`` is traced so blocks sharing (rb, caps) share a compile (the
+    ``_windowed_block_local`` convention, distributed)."""
+    from ..ops.spgemm import accumulate_block_scatter, mask_rows
+
+    grid = A.grid
+    p = grid.pr
+    lrA, lcB = A.local_rows, B.local_cols
+    pcols = -(-lcB // 128) * 128
+    zero = float(np.asarray(sr.zero_fn(A.vals.dtype)))
+
+    def body(lo_, ar, ac, av, an, br, bc, bv, bn):
+        lo_ = lo_[0, 0]
+        a_mine = A.local_tile(ar, ac, av, an)
+        b_mine = B.local_tile(br, bc, bv, bn)
+        a_stages = _gather_stage_tiles(a_mine, COL_AXIS, p)
+        b_stages = _gather_stage_tiles(b_mine, ROW_AXIS, p)
+        acc = jnp.full((rb, pcols), zero, A.vals.dtype)
+        for s in range(p):
+            am = mask_rows(a_stages[s], lo_, lo_ + rb)
+            acc = accumulate_block_scatter(
+                sr, acc, am, CSR.from_tuples(b_stages[s]), row_lo=lo_,
+                flop_capacity=flop_cap, chunk_w=chunk_w,
+            )
+        chunk, over = _extract_block_1d(
+            acc, zero, lo_, rb, lrA, lcB, out_cap
+        )
+        over = lax.pmax(lax.pmax(over, ROW_AXIS), COL_AXIS)
+        return SpParMat._pack_tile(chunk) + (over[None, None],)
+
+    lo_arr = jnp.broadcast_to(
+        jnp.int32(lo), (grid.pr, grid.pc)
+    )
+    lo_arr = jax.device_put(
+        lo_arr, jax.sharding.NamedSharding(grid.mesh, TILE_SPEC)
+    )
+    return jax.shard_map(
+        body,
+        mesh=grid.mesh,
+        in_specs=(TILE_SPEC,) * 9,
+        out_specs=(TILE_SPEC,) * 5,
+        check_vma=False,
+    )(lo_arr, A.rows, A.cols, A.vals, A.nnz,
+      B.rows, B.cols, B.vals, B.nnz)
+
+
+def summa_spgemm_windowed_blocked(
+    sr: Semiring,
+    A: SpParMat,
+    B: SpParMat,
+    *,
+    block_rows: int,
+    flop_caps: tuple,
+    out_caps: tuple,
+    skip: tuple,
+    chunk_w: int = 8,
+    serialize: bool = True,
+) -> tuple[SpParMat, jax.Array]:
+    """BLOCKED-DISPATCH distributed windowed tier (scatter backend): a
+    host loop launching one small shard_map program per OCCUPIED row
+    block instead of the one fused graph.
+
+    The fused ``summa_spgemm_windowed`` unrolls every block into one
+    program; XLA:CPU's scheduler then materializes many multi-GB dense
+    accumulators concurrently — at scale 18 on the 2×2 virtual mesh the
+    fused graph's live set exceeded 125 GB (r9 capture: OOM), the
+    distributed incarnation of the r7 single-device lesson that led to
+    ``local_spgemm_windowed``.  Per-block dispatch bounds the live set
+    to ONE block's accumulator + expansion per device, at the cost of
+    re-gathering the stage tiles per block (nblocks × p × tile bytes —
+    noise next to the accumulate).  Blocks sharing (rb, caps) share a
+    compile (``lo`` is traced); callers wanting maximal sharing pass
+    uniform pow2 caps.
+
+    Same plan/caps contract and output-layout contract as the fused
+    kernel (valid slots form a compacted prefix per block).
+
+    ``serialize=True`` (default) blocks on each block program before
+    dispatching the next: XLA:CPU's multi-thread collective rendezvous
+    deadlocks when device threads interleave DIFFERENT in-flight
+    programs' gathers (observed at scale 18 — all threads futex-wait),
+    so cross-program async pipelining is traded away; per-block
+    dispatch overhead is noise next to the accumulate.  On hardware
+    pods with ordered per-device streams, pass ``serialize=False`` to
+    let dispatch run ahead."""
+    assert len(flop_caps) == len(out_caps) == len(skip)
+    lrA = A.local_rows
+    parts = []
+    nnz = None
+    worst = jnp.int32(0)
+    for g in packed_windows(skip):
+        lo = g * block_rows
+        rb = min(block_rows, lrA - lo)
+        r, c, v, n, over = _windowed_block_dist(
+            sr, A, B, lo, rb=rb,
+            flop_cap=max(flop_caps[g], chunk_w),
+            out_cap=out_caps[g], chunk_w=chunk_w,
+        )
+        if serialize:
+            jax.block_until_ready(n)
+        parts.append((r, c, v))
+        nnz = n if nnz is None else nnz + n
+        worst = jnp.maximum(worst, over[0, 0])
+    if not parts:
+        empty = SpParMat.from_global_coo(
+            A.grid, np.zeros(0, np.int64), np.zeros(0, np.int64),
+            np.zeros(0, A.vals.dtype), A.nrows, B.ncols,
+        )
+        return empty, jnp.int32(0)
+    mat = SpParMat(
+        rows=jnp.concatenate([p[0] for p in parts], axis=2),
+        cols=jnp.concatenate([p[1] for p in parts], axis=2),
+        vals=jnp.concatenate([p[2] for p in parts], axis=2),
+        nnz=nnz, nrows=A.nrows, ncols=B.ncols, grid=A.grid,
+    )
+    return mat, worst
+
+
 def resolve_spgemm_backend(backend: str | None = None) -> str:
     """Accumulate-backend resolution, shared by the router and the sized
     entries: explicit argument > ``COMBBLAS_SPGEMM_BACKEND`` env > the
@@ -1918,6 +2352,8 @@ def spgemm_windowed(
     slack: float = 1.02,
     interpret: bool = False,
     oracle: bool = False,
+    ring: bool = False,
+    pipeline: bool = True,
 ) -> SpParMat:
     """Sized entry for the windowed tier: device symbolic pass →
     ``windowed_plan`` (scatter, 1D) or ``windowed_plan_2d`` (dot, 2D) →
@@ -1928,7 +2364,15 @@ def spgemm_windowed(
 
     ``oracle=True`` (dot, single device, inside the support-oracle
     envelope) replaces the clamped-flops out caps with the EXACT
-    per-window output counts from the bit-packed support oracle.
+    per-window output counts from the bit-packed support oracle — which
+    also SHRINKS the packed launch list: flops-positive but
+    output-empty windows become skips, so the kernel pays one MXU
+    launch per genuinely occupied window
+    (``spgemm.windowed.windows_packed`` / ``.pack_ratio``).
+
+    ``ring=True`` (multi-device only) runs the stage-pipelined carousel
+    schedule instead of the gathered one; ``pipeline=False`` pins the
+    serial-chain control (see ``summa_spgemm_windowed``).
     """
     backend = resolve_spgemm_backend(backend)
     if block_rows is None:
@@ -1975,6 +2419,13 @@ def spgemm_windowed(
         if obs.ENABLED:
             nsk = sum(sum(row) for row in skip)
             obs.count("spgemm.windowed.col_windows_skipped", nsk)
+            npk = len(packed_windows_2d(skip))
+            ntot = sum(len(row) for row in skip)
+            obs.count("spgemm.windowed.windows_packed", npk)
+            obs.gauge(
+                "spgemm.windowed.pack_ratio",
+                npk / ntot if ntot else 0.0,
+            )
             obs.gauge(
                 "spgemm.windowed.col_windows",
                 len(skip[0]) if skip else 0,
@@ -2019,6 +2470,7 @@ def spgemm_windowed(
                 out_caps=out_caps, skip=skip, backend="dot", mode=mode,
                 chunk_w=chunk_w, interpret=interpret,
                 block_cols=block_cols, panel_cap=panel_cap,
+                ring=ring, pipeline=pipeline,
             )
         over = int(overflow)
         assert over <= 0, (
@@ -2037,6 +2489,12 @@ def spgemm_windowed(
     )
     if obs.ENABLED:
         obs.count("spgemm.windowed.windows_skipped", sum(skip))
+        npk = len(packed_windows(skip))
+        obs.count("spgemm.windowed.windows_packed", npk)
+        obs.gauge(
+            "spgemm.windowed.pack_ratio",
+            npk / len(skip) if skip else 0.0,
+        )
         obs.gauge("spgemm.windowed.blocks", len(skip))
         cells = max(A.local_rows * B.local_cols, 1)
         obs.gauge(
@@ -2056,7 +2514,8 @@ def spgemm_windowed(
         C, overflow = summa_spgemm_windowed(
             sr, A, B, block_rows=block_rows, flop_caps=flop_caps,
             out_caps=out_caps, skip=skip, backend=backend, mode=mode,
-            chunk_w=chunk_w, interpret=interpret,
+            chunk_w=chunk_w, interpret=interpret, ring=ring,
+            pipeline=pipeline,
         )
     over = int(overflow)
     # out_caps are symbolic UPPER bounds — overflow means the symbolic
@@ -2157,6 +2616,7 @@ def choose_spgemm_tier(
     *,
     backend: str | None = None,
     assume_unique: bool = False,
+    grid3=None,
 ) -> str:
     """The routing rule of ``spgemm_auto`` (host-side, observable):
 
@@ -2178,10 +2638,41 @@ def choose_spgemm_tier(
       "scan"      everything else — output-bounded ESC (the general
                   fallback; exact for every semiring).
 
+    With a LAYERED mesh available (``grid3`` with ``layers > 1`` whose
+    layout fits the product — ``mesh3d.summa3d_compatible``), a product
+    the 2D rule routes to ``windowed`` upgrades to ``"windowed3d"``:
+    the same windowed kernel run per layer on the 3D mesh
+    (``spgemm3d_windowed``), where layer replication cuts per-stage
+    gather volume L-fold.  Products the 2D rule sends to mxu or scan
+    keep their 2D tier (small tiles don't pay conversion; scan-sparse
+    outputs would multiply the extraction scans by L).
+
     Forced override: ``spgemm_auto(tier=...)`` or env
     ``COMBBLAS_SPGEMM_TIER``; backend via argument, env
     ``COMBBLAS_SPGEMM_BACKEND``, or the platform default.
     """
+    tier = _choose_spgemm_tier_2d(
+        sr, A, B, backend=backend, assume_unique=assume_unique
+    )
+    if grid3 is not None and tier == "windowed":
+        from .mesh3d import summa3d_compatible
+
+        if grid3.layers > 1 and summa3d_compatible(
+            grid3, A.nrows, A.ncols, B.ncols
+        ):
+            return "windowed3d"
+    return tier
+
+
+def _choose_spgemm_tier_2d(
+    sr: Semiring,
+    A: SpParMat,
+    B: SpParMat,
+    *,
+    backend: str | None = None,
+    assume_unique: bool = False,
+) -> str:
+    """The 2D rungs of ``choose_spgemm_tier`` (see its docstring)."""
     from ..ops.spgemm import scatter_combine_for
 
     backend = resolve_spgemm_backend(backend)
@@ -2255,6 +2746,9 @@ def spgemm_auto(
     backend: str | None = None,
     oracle: bool = False,
     assume_unique: bool = False,
+    grid3=None,
+    ring: bool = False,
+    pipeline: bool = True,
 ) -> SpParMat:
     """Auto-tiered sparse-output SpGEMM: route (shape, density, semiring)
     through the fastest applicable kernel instead of defaulting to ESC.
@@ -2310,9 +2804,10 @@ def spgemm_auto(
         block_cols = (int(env_bc) or None) if env_bc else None
     if tier is None:
         tier = choose_spgemm_tier(
-            sr, A, B, backend=backend, assume_unique=assume_unique
+            sr, A, B, backend=backend, assume_unique=assume_unique,
+            grid3=grid3,
         )
-    assert tier in ("mxu", "windowed", "scan", "esc"), tier
+    assert tier in ("mxu", "windowed", "scan", "esc", "windowed3d"), tier
     if obs.ENABLED:
         obs.count("spgemm.auto.tier", tier=tier, sr=sr.name)
     with obs.span("spgemm.auto", sr=sr.name, tier=tier):
@@ -2327,8 +2822,29 @@ def spgemm_auto(
             return spgemm_windowed(
                 sr, A, B, block_rows=block_rows, block_cols=block_cols,
                 backend=backend, mode=mode, slack=slack,
-                interpret=interpret, oracle=oracle,
+                interpret=interpret, oracle=oracle, ring=ring,
+                pipeline=pipeline,
             )
+        if tier == "windowed3d":
+            # the layered route: 2D operands → 3D splits (on-device
+            # redistribution), per-layer windowed SUMMA, fiber reduce,
+            # back to the caller's 2D grid — one call, same contract
+            assert grid3 is not None, (
+                "tier='windowed3d' needs a grid3 (the layered mesh)"
+            )
+            from .mesh3d import SpParMat3D, spgemm3d_windowed
+
+            A3 = SpParMat3D.from_spmat(A, grid3, split="col")
+            B3 = SpParMat3D.from_spmat(B, grid3, split="row")
+            # oracle/ring/pipeline are 2D-schedule knobs: the 3D tier's
+            # per-layer SUMMA is the gathered schedule (a 3D carousel is
+            # an open ROADMAP item) and oracle seeding is 2D-plan-only
+            C3 = spgemm3d_windowed(
+                sr, A3, B3, block_rows=block_rows,
+                block_cols=block_cols, backend=backend, mode=mode,
+                slack=slack, interpret=interpret,
+            )
+            return C3.to_spmat(A.grid)
         # tier == "mxu": the round-4 whole-tile dense path
         if out_capacity is None:
             out_capacity = max(A.capacity, B.capacity, 64)

@@ -307,7 +307,17 @@ def test_validate_bfs_device(shape, rng):
     assert v3[1, 0] > 0 or v3[3, 0] > 0
 
 
-@pytest.mark.parametrize("shape", [(1, 1), (2, 2), (2, 4)])
+@pytest.mark.parametrize(
+    "shape",
+    [
+        (1, 1),
+        # the multi-device shapes re-run the same tier ladder ~70 s each
+        # on the 1-core CPU mesh; grid coverage of bfs_single rides the
+        # (1,1) case + the batch tests above, so they run under -m slow
+        pytest.param((2, 2), marks=pytest.mark.slow),
+        pytest.param((2, 4), marks=pytest.mark.slow),
+    ],
+)
 def test_bfs_single_matches(shape):
     """Single-root tiered BFS (the spec's sequential kernel 2): identical
     levels to the reference bfs() and a valid tree, across tier regimes

@@ -267,6 +267,8 @@ def test_mcl_chaos_every_matches(rng):
     assert it1 <= it2 <= it1 + 2
 
 
+@pytest.mark.slow  # ~26 s of reroll recompiles on the 1-core CPU mesh;
+# the chaos-every path itself stays tier-1 via test_mcl_chaos_every_matches
 def test_mcl_chaos_every_overflow_reroll(rng):
     """A deliberately tiny initial capacity must trigger the on-device
     overflow flag and the save-and-reroll path, still converging exactly."""

@@ -202,6 +202,64 @@ def test_windowed_dot_counters_gated(rng):
         obs.reset()
 
 
+def test_round9_pipeline_pack_3d_counters_gated(rng):
+    """ISSUE 7 satellite: the round-9 series — pipelined-carousel
+    overlap count, packed-launch counters, and the 3D layers gauge —
+    are emitted under obs and cost NOTHING when disabled (the zero-cost
+    gate extended to the round-9 series)."""
+    from combblas_tpu import PLUS_TIMES
+    from combblas_tpu.parallel.grid import Grid
+    from combblas_tpu.parallel.mesh3d import Grid3D
+    from combblas_tpu.parallel.spgemm import spgemm_auto, spgemm_windowed
+    from combblas_tpu.parallel.spmat import SpParMat
+
+    grid = Grid.make(2, 2)
+    m = 64
+    r = rng.integers(0, m, 400).astype(np.int64)
+    c = rng.integers(0, m, 400).astype(np.int64)
+    A = SpParMat.from_global_coo(
+        grid, r, c, np.ones(400, np.float32), m, m
+    )
+    assert not obs.ENABLED
+    spgemm_windowed(
+        PLUS_TIMES, A, A, block_rows=16, backend="scatter", ring=True
+    )
+    assert obs.registry.empty()  # disabled: zero bookkeeping
+    assert obs._spans.empty()
+    obs.enable(install_hooks=False)
+    try:
+        obs.reset()
+        # fresh static config (different block_rows) forces a retrace so
+        # the trace-time counters fire under the enabled registry
+        spgemm_windowed(
+            PLUS_TIMES, A, A, block_rows=8, backend="scatter", ring=True
+        )
+        assert obs.registry.get_counter(
+            "spgemm.pipeline.stages_overlapped"
+        ) == grid.pr - 1
+        assert obs.registry.get_counter(
+            "trace.summa_spgemm_windowed", backend="scatter", ring=True
+        ) == 1
+        packed = obs.registry.get_counter("spgemm.windowed.windows_packed")
+        assert packed >= 1
+        ratio = obs.registry.get_gauge("spgemm.windowed.pack_ratio")
+        assert 0 < ratio <= 1.0
+        # the 3D route records its layer count
+        obs.reset()
+        g3 = Grid3D.make(2, 2, 2)
+        spgemm_auto(
+            PLUS_TIMES, A, A, tier="windowed3d", grid3=g3,
+            backend="scatter", block_rows=16,
+        )
+        assert obs.registry.get_gauge("spgemm.summa3d.layers") == 2
+        assert obs.registry.get_counter(
+            "spgemm.auto.tier", tier="windowed3d", sr="plus_times"
+        ) == 1
+    finally:
+        obs.disable()
+        obs.reset()
+
+
 # --- JSONL round-trip + multihost merge -------------------------------------
 
 

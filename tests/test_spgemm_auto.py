@@ -393,8 +393,21 @@ def test_default_block_rows_bounds():
 # --- 2D B-column-windowed dot backend (ISSUE 5 tentpole) --------------------
 
 
-@pytest.mark.parametrize("srname", ["plus_times", "min_plus", "max_min"])
-@pytest.mark.parametrize("p", [1, 2])
+@pytest.mark.parametrize(
+    "p,srname",
+    [
+        (1, "plus_times"),
+        (1, "min_plus"),
+        (1, "max_min"),
+        (2, "plus_times"),
+        # the distributed tropical (Pallas-matmul) cases cost ~20 s each
+        # on the 1-core mesh; the tropical dot2d path stays tier-1 at
+        # p=1 and the 2x2 fused kernel at plus_times, so these two run
+        # under -m slow
+        pytest.param(2, "min_plus", marks=pytest.mark.slow),
+        pytest.param(2, "max_min", marks=pytest.mark.slow),
+    ],
+)
 def test_windowed_dot_2d_matches_esc_across_semirings(rng, srname, p):
     """Forced dot-backend 2D windowed == ESC golden across semirings,
     DUPLICATE-ENTRY COO inputs included: ``densify_combine`` folds
@@ -633,6 +646,225 @@ def test_windowed_dot_panel_envelope():
         PLUS_TIMES, 1 << 17, (1 << 17) * (1 << 16), 1, 1e12, "dot",
         k_dim=1 << 17, n_dim=1 << 16,
     ) == "scan"
+
+
+# --- round 9: pipelined carousel, packed launches, 3D windowed --------------
+
+
+@pytest.mark.parametrize("srname", ["plus_times", "min_plus", "max_min"])
+def test_pipelined_carousel_matches_unpipelined(rng, srname):
+    """ISSUE 7 satellite: the stage-pipelined windowed carousel
+    (ring=True, pipeline=True) and the serial-chain control
+    (pipeline=False) both agree exactly with the ESC golden on a 2x2
+    grid with DUPLICATE-entry COO input — the overlap restructure is a
+    schedule change, never a semantics change."""
+    from combblas_tpu.parallel.spgemm import spgemm_windowed
+
+    sr = {"plus_times": PLUS_TIMES, "min_plus": MIN_PLUS,
+          "max_min": MAX_MIN}[srname]
+    grid = Grid.make(2, 2)
+    m, k, n = 64, 48, 80
+    ra, ca, va = coo(rng, m, k, 500, dup_frac=0.2)
+    rb, cb, vb = coo(rng, k, n, 600, dup_frac=0.2)
+    A = SpParMat.from_global_coo(grid, ra, ca, va, m, k)
+    B = SpParMat.from_global_coo(grid, rb, cb, vb, k, n)
+    ref = dense_of(spgemm(sr, A, B))
+    for pipe in (True, False):
+        C = spgemm_windowed(
+            sr, A, B, block_rows=16, backend="scatter",
+            ring=True, pipeline=pipe,
+        )
+        np.testing.assert_allclose(
+            dense_of(C), ref, rtol=1e-5, atol=1e-6
+        )
+
+
+def test_pipelined_carousel_dot2d_and_esc_ring(rng):
+    """The carousel restructure covers every ring path: the 2D dot
+    windowed carousel and the (now pipelined) ESC ring both match the
+    gathered-schedule golden."""
+    from combblas_tpu.parallel.spgemm import (
+        spgemm_windowed,
+        summa_capacities,
+        summa_spgemm,
+    )
+
+    grid = Grid.make(2, 2)
+    m = 96
+    ra, ca, va = coo(rng, m, m, 800, dup_frac=0.15)
+    A = SpParMat.from_global_coo(grid, ra, ca, va, m, m)
+    ref = dense_of(spgemm(PLUS_TIMES, A, A))
+    for pipe in (True, False):
+        C = spgemm_windowed(
+            PLUS_TIMES, A, A, block_rows=16, block_cols=32,
+            backend="dot", ring=True, pipeline=pipe,
+        )
+        np.testing.assert_allclose(
+            dense_of(C), ref, rtol=1e-5, atol=1e-6
+        )
+    fcap, ocap = summa_capacities(A, A)
+    C = summa_spgemm(
+        PLUS_TIMES, A, A, flop_capacity=fcap, out_capacity=ocap,
+        ring=True,
+    )
+    np.testing.assert_allclose(dense_of(C), ref, rtol=1e-5, atol=1e-6)
+
+
+def test_packed_plan_equals_skiplist(rng):
+    """ISSUE 7 satellite: the packed launch list is exactly the
+    complement of the skip list, and a packed (skip-listed) run emits
+    the SAME output as the full-grid run with no skips — packing elides
+    launches, never results."""
+    from combblas_tpu.parallel.spgemm import (
+        _live_windows_by_block,
+        packed_windows,
+        packed_windows_2d,
+        panel_cap_from_bnnz,
+        summa_window_bnnz,
+        summa_window_flops_pair,
+    )
+
+    grid = Grid.make(1, 1)
+    m = 64
+    # A confined to rows [0, 24): the lower row blocks are empty
+    ra = rng.integers(0, 24, 200).astype(np.int64)
+    ca = rng.integers(0, m, 200).astype(np.int64)
+    A = SpParMat.from_global_coo(
+        grid, ra, ca, np.ones(200, np.float32), m, m
+    )
+    rb = rng.integers(0, m, 300).astype(np.int64)
+    cb = rng.integers(0, 32, 300).astype(np.int64)  # right windows empty
+    B = SpParMat.from_global_coo(
+        grid, rb, cb, np.ones(300, np.float32), m, m
+    )
+    pair = np.asarray(
+        jax.device_get(summa_window_flops_pair(A, B, 8, 16, chunk_w=8))
+    )
+    fc, oc, skip = windowed_plan_2d(pair[0], pair[1], 8, 16, m, m)
+    pairs = packed_windows_2d(skip)
+    # the packed list IS the complement of the skip list, in kernel order
+    assert pairs == tuple(
+        (g, h) for g in range(len(skip)) for h in range(len(skip[0]))
+        if not skip[g][h]
+    )
+    assert 0 < len(pairs) < len(skip) * len(skip[0])
+    assert packed_windows(tuple(all(row) for row in skip)) == tuple(
+        g for g, hs in _live_windows_by_block(skip)
+    )
+    panel_cap = panel_cap_from_bnnz(
+        jax.device_get(summa_window_bnnz(B, 16)), int(B.capacity)
+    )
+    no_skip = tuple((False,) * len(row) for row in skip)
+    outs = {}
+    for name, sk in (("packed", skip), ("full", no_skip)):
+        C, overflow = summa_spgemm_windowed(
+            PLUS_TIMES, A, B, block_rows=8, flop_caps=fc, out_caps=oc,
+            skip=sk, backend="dot", block_cols=16, panel_cap=panel_cap,
+        )
+        assert int(overflow) <= 0
+        outs[name] = dense_of(C)
+    np.testing.assert_array_equal(outs["packed"], outs["full"])
+    np.testing.assert_allclose(
+        outs["packed"], dense_of(spgemm(PLUS_TIMES, A, B)),
+        rtol=1e-5, atol=1e-6,
+    )
+
+
+def test_blocked_dispatch_matches_fused(rng):
+    """ISSUE 7: the blocked-dispatch distributed windowed tier (one
+    small shard_map program per occupied row block — the live-set
+    bound that fits scale-18 tiles in RAM) emits the same result as
+    the fused kernel and the ESC golden, duplicate entries included."""
+    from combblas_tpu.parallel.spgemm import (
+        WINDOWED_CHUNK_W,
+        summa_rowblock_flops_host,
+        summa_spgemm_windowed_blocked,
+    )
+
+    grid = Grid.make(2, 2)
+    m = 96
+    ra, ca, va = coo(rng, m, m, 800, dup_frac=0.15)
+    # rows confined to [0, 32): the trailing row blocks are empty on
+    # EVERY grid row, so the packed host loop's skip path is exercised
+    ra = ra % 32
+    A = SpParMat.from_global_coo(grid, ra, ca, va, m, m)
+    pb = summa_rowblock_flops_host(
+        grid, ra, ca, ra, ca, m, m, m, 16, chunk_w=WINDOWED_CHUNK_W
+    )
+    pt = summa_rowblock_flops_host(
+        grid, ra, ca, ra, ca, m, m, m, 16, chunk_w=0
+    )
+    fc, oc, skip = windowed_plan(pb, pt, 16, A.local_rows, A.local_cols)
+    assert any(skip)
+    C, over = summa_spgemm_windowed_blocked(
+        PLUS_TIMES, A, A, block_rows=16, flop_caps=fc, out_caps=oc,
+        skip=skip, chunk_w=WINDOWED_CHUNK_W,
+    )
+    assert int(over) <= 0
+    C_f, over_f = summa_spgemm_windowed(
+        PLUS_TIMES, A, A, block_rows=16, flop_caps=fc, out_caps=oc,
+        skip=skip, backend="scatter", chunk_w=WINDOWED_CHUNK_W,
+    )
+    assert int(over_f) <= 0
+    np.testing.assert_array_equal(dense_of(C), dense_of(C_f))
+    np.testing.assert_allclose(
+        dense_of(C), dense_of(spgemm(PLUS_TIMES, A, A)),
+        rtol=1e-5, atol=1e-6,
+    )
+    assert host_nnz(C) == host_nnz(C_f)
+
+
+def test_spgemm_auto_3d_matches_2d(rng):
+    """ISSUE 7 satellite: the windowed3d route (2D → layered 3D mesh →
+    per-layer windowed SUMMA → fiber reduce → back to 2D) agrees
+    BIT-EXACTLY with the 2D spgemm_auto product on the 8-device mesh
+    (0/1 adjacency counts are integers)."""
+    from combblas_tpu.parallel.mesh3d import Grid3D
+
+    grid = Grid.make(2, 2)
+    g3 = Grid3D.make(2, 2, 2)
+    m = 64
+    ra, ca, _ = coo(rng, m, m, 900, dup_frac=0.1)
+    A = SpParMat.from_global_coo(
+        grid, ra, ca, np.ones(len(ra), np.float32), m, m
+    )
+    ref = spgemm_auto(PLUS_TIMES, A, A, tier="windowed", block_rows=16)
+    for backend in ("scatter", "dot"):
+        C = spgemm_auto(
+            PLUS_TIMES, A, A, tier="windowed3d", grid3=g3,
+            backend=backend, block_rows=16, block_cols=16,
+        )
+        np.testing.assert_array_equal(dense_of(C), dense_of(ref))
+        assert host_nnz(C) == host_nnz(ref)
+
+
+def test_router_upgrades_windowed_to_3d(rng, monkeypatch):
+    """choose_spgemm_tier upgrades a 2D-windowed-bound product to
+    windowed3d when a COMPATIBLE layered mesh is offered — and keeps
+    the 2D tier when the layout does not divide over the layers."""
+    import combblas_tpu.parallel.spgemm as psp
+    from combblas_tpu.parallel.mesh3d import Grid3D, summa3d_compatible
+
+    monkeypatch.setattr(psp, "MXU_MAX_TILE_DIM", 32)
+    grid = Grid.make(1, 1)
+    m = 96
+    ra, ca, va = coo(rng, m, m, 2000)
+    A = SpParMat.from_global_coo(grid, ra, ca, va, m, m)
+    g3 = Grid3D.make(2, 2, 2)
+    assert psp.choose_spgemm_tier(
+        PLUS_TIMES, A, A, backend="scatter"
+    ) == "windowed"
+    assert psp.choose_spgemm_tier(
+        PLUS_TIMES, A, A, backend="scatter", grid3=g3
+    ) == "windowed3d"
+    # an odd dimension cannot col-split over 2 layers: router stays 2D
+    assert not summa3d_compatible(g3, 98, 98, 98)
+    ra2 = np.minimum(ra, 97)
+    ca2 = np.minimum(ca, 97)
+    A2 = SpParMat.from_global_coo(grid, ra2, ca2, va, 98, 98)
+    assert psp.choose_spgemm_tier(
+        PLUS_TIMES, A2, A2, backend="scatter", grid3=g3
+    ) == "windowed"
 
 
 def test_support_oracle_window_counts_and_seeding(rng):
