@@ -697,6 +697,12 @@ def emit_summary(official, rc: int = 0, path: str | None = None) -> None:
         "warning": official.get("warning"),
         "rc": rc,
     }
+    # round-10 plan provenance (store hit vs probe vs heuristic + the
+    # chosen knobs) rides along when the child reported it — still a
+    # compact, truncation-proof line
+    for k in ("plan_source", "plan"):
+        if official.get(k) is not None:
+            s[k] = official[k]
     path = path or os.environ.get("BENCH_SUMMARY_PATH", "BENCH_SUMMARY.json")
     try:
         with open(path, "w") as f:

@@ -42,7 +42,17 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 SCALE = int(os.environ.get("BENCH_SCALE", "12"))
 NDEV = int(os.environ.get("BENCH_NDEV", "8"))
 REPS = int(os.environ.get("BENCH_REPS", "3"))
-KERNEL = os.environ.get("BENCH_KERNEL", "esc")  # esc | windowed
+# esc | windowed | auto — auto resolves through the tuner precedence
+# (plan store > COMBBLAS_SPGEMM3D_TIER env > "esc") and reports the
+# provenance in the per-config JSON + final summary (round 10)
+KERNEL = os.environ.get("BENCH_KERNEL", "esc")
+# BENCH_PLAN_STORE / BENCH_PLAN_RECORD: the spgemm_bench.py round-10
+# knobs — point the measured-plan store somewhere ("0" disables) and
+# optionally write the BEST configuration's tier back (how 3D store
+# records get seeded; spgemm3d has no probe pass).
+if os.environ.get("BENCH_PLAN_STORE") is not None:
+    os.environ["COMBBLAS_PLAN_STORE"] = os.environ["BENCH_PLAN_STORE"]
+PLAN_RECORD = os.environ.get("BENCH_PLAN_RECORD", "0") == "1"
 EDGEFACTOR = int(os.environ.get("BENCH_EDGEFACTOR", "8"))
 # golden scipy A² per configuration: default ON only at sweep scales
 # where the host product is cheap — above scale 14 the ~1e9-nnz golden
@@ -64,6 +74,10 @@ def emit_summary(official, rc: int = 0, path: str | None = None) -> None:
         "warning": official.get("warning"),
         "rc": rc,
     }
+    # round-10 plan provenance rides along when present (still compact)
+    for k in ("plan_source", "plan"):
+        if official.get(k) is not None:
+            s[k] = official[k]
     path = path or os.environ.get(
         "BENCH_SUMMARY_PATH", "BENCH_SUMMARY.json"
     )
@@ -110,6 +124,11 @@ def run() -> dict:
         golden = S @ S
         golden.sort_indices()
 
+    from combblas_tpu.tuner import config as tuner_config
+    from combblas_tpu.tuner import store as tuner_store
+
+    store = tuner_store.get_store()
+
     configs = []
     for L in (1, 2, 4, 8):
         if NDEV % L:
@@ -129,8 +148,30 @@ def run() -> dict:
         A3 = SpParMat3D.from_global_coo(g3, ru, cu, vals, n, n, split="col")
         B3 = SpParMat3D.from_global_coo(g3, ru, cu, vals, n, n, split="row")
 
+        # per-config provenance (BENCH_KERNEL=auto follows the tuner
+        # precedence; a named kernel is "arg").  For auto the bench
+        # passes tier=None and lets the LIBRARY resolve — its lookup is
+        # the one that counts hits and emits spgemm.auto.plan_source;
+        # the mirror below (peek: no accounting) only fills the JSON.
+        forced = None if KERNEL == "auto" else KERNEL
+        tier = forced
+        plan_source = "arg" if forced is not None else None
+        cfg_key = tuner_store.plan_key_from_counts(
+            "plus_times", n, n, n, len(ru), len(ru),
+            tuner_config.env_backend() or "", f"{pr}x{pc}",
+            grid3=f"{L}x{pr}x{pc}", op="spgemm3d",
+        )
+        if tier is None:
+            rec = store.peek(cfg_key) if store is not None else None
+            if rec is not None and rec.tier in ("esc", "windowed"):
+                tier, plan_source = rec.tier, "store"
+            elif tuner_config.env_tier3d() is not None:
+                tier, plan_source = tuner_config.env_tier3d(), "env"
+            else:
+                tier, plan_source = "esc", "heuristic"
+
         def mult():
-            return spgemm3d(PLUS_TIMES, A3, B3, tier=KERNEL)
+            return spgemm3d(PLUS_TIMES, A3, B3, tier=forced)
 
         C = mult()  # warmup/compile + sizes caches
         jax.block_until_ready(C.vals)
@@ -149,6 +190,9 @@ def run() -> dict:
             "out_nnz": int(jax.device_get(C.getnnz())),
             "ndev": NDEV,
             "kernel": KERNEL,
+            "tier": tier,
+            "plan_source": plan_source,
+            "plan_key_grid3": f"{L}x{pr}x{pc}",
         }
         if golden is not None:
             gr, gc_, gv = C.to_global_coo()
@@ -177,6 +221,27 @@ def run() -> dict:
         r.get("golden_exact") for r in results
     ):
         warning = "golden mismatch in at least one configuration"
+    if PLAN_RECORD and store is not None:
+        # seed the 3D plan store with the best configuration's tier
+        # (keyed to ITS grid3; a later auto run routes through it) —
+        # only when it beats the remembered cost (sweep-order must not
+        # decide which plan survives)
+        bL, bpr, bpc = best["plan_key_grid3"].split("x")
+        best_key = tuner_store.plan_key_from_counts(
+            "plus_times", n, n, n, len(ru), len(ru),
+            tuner_config.env_backend() or "", f"{bpr}x{bpc}",
+            grid3=best["plan_key_grid3"], op="spgemm3d",
+        )
+        prev = store.peek(best_key)
+        if (
+            prev is None
+            or prev.cost_s is None
+            or prev.cost_s > best["value"] / 1e3
+        ):
+            store.put(best_key, tuner_store.PlanRecord(
+                tier=best["tier"], cost_s=best["value"] / 1e3,
+                source="bench",
+            ))
     if obs.ENABLED:
         obs.dump_jsonl()
     return {
@@ -184,6 +249,9 @@ def run() -> dict:
         "value": best["value"],
         "median": vals_ms[(len(vals_ms) - 1) // 2],
         "warning": warning,
+        "plan_source": best["plan_source"],
+        "plan": {"tier": best["tier"], "grid3": best["plan_key_grid3"]},
+        "tuner": None if store is None else store.stats(),
     }
 
 

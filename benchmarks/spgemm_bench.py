@@ -56,12 +56,111 @@ EDGEFACTOR = int(os.environ.get("BENCH_EDGEFACTOR", "8"))
 # f32 | bf16 | bf16x3.  f32 default — exact everywhere; on the chip
 # bf16 is the fast mode (exact for 0/1 counts < 2^24).
 DOT_MODE = os.environ.get("BENCH_DOT_MODE", "f32")
+# --- round-10 plan-store knobs ---------------------------------------------
+# BENCH_PLAN_STORE=dir points the measured-plan store at `dir` ("0"
+# disables) — it simply sets COMBBLAS_PLAN_STORE before the library
+# loads, so BENCH_KERNEL=auto resolves through the store (tuner
+# precedence: store > env > probe > heuristic; probing via
+# COMBBLAS_TUNER_PROBE=1 runs IN-PROCESS before the timed section — on
+# readback-poisoned chips keep probing in a separate process, which the
+# A/B scenario below does by construction).
+if os.environ.get("BENCH_PLAN_STORE") is not None:
+    os.environ["COMBBLAS_PLAN_STORE"] = os.environ["BENCH_PLAN_STORE"]
+# BENCH_PLAN_RECORD=1: write THIS run's measured (kernel, knobs, cost)
+# back into the store (source="bench") — how operators seed a fleet
+# store from forced-kernel sweeps.
+PLAN_RECORD = os.environ.get("BENCH_PLAN_RECORD", "0") == "1"
+# BENCH_TUNER_AB=1: the warm-vs-cold-process scenario — three children
+# of this same script at the current BENCH_* settings: `heuristic`
+# (store disabled), `cold` (fresh store + probing: pays the probe,
+# writes the winner), `warm` (same store: hits the plan, ZERO probe
+# runs). Prints one combined JSON line.
+TUNER_AB = os.environ.get("BENCH_TUNER_AB", "0") == "1"
+# BENCH_FIRST_TOUCH=1 (windowed): time the FIRST mult call — compile
+# included — instead of the warm loop; with BENCH_PR>1 and
+# BENCH_DISPATCH=fused|blocked this is the bounded-compile A/B of the
+# building-block decomposition (ISSUE 8 acceptance).
+FIRST_TOUCH = os.environ.get("BENCH_FIRST_TOUCH", "0") == "1"
 _EFTAG = f"ef{EDGEFACTOR}" if EDGEFACTOR != 8 else ""
 _GRIDTAG = f"_p{PR}x{PR}" if PR > 1 else ""
 _RINGTAG = ("_ring" if PIPELINE else "_ringserial") if RING else ""
 
 
+def tuner_ab():
+    """BENCH_TUNER_AB=1: heuristic / cold-probe / warm-store children
+    (one process each — the warm child is the 'fresh replica with a
+    shipped plan store' of the acceptance gate).  Asserts in-JSON that
+    the warm child routed from the store with zero probe runs."""
+    import subprocess
+    import tempfile
+
+    store_dir = os.environ.get("BENCH_PLAN_STORE") or tempfile.mkdtemp(
+        prefix="bench-plans-"
+    )
+
+    def child(tag, env_over):
+        env = dict(os.environ)
+        env.pop("BENCH_TUNER_AB", None)
+        # the child re-applies BENCH_PLAN_STORE over COMBBLAS_PLAN_STORE
+        # at import — strip it so the per-child store assignment below
+        # is authoritative (else the heuristic child would route through
+        # a pre-warmed store and the baseline would be a second warm run)
+        env.pop("BENCH_PLAN_STORE", None)
+        env.setdefault("BENCH_GOLDEN", "0")  # A/B times routing, not golden
+        env["BENCH_KERNEL"] = "auto"
+        env.update(env_over)
+        p = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env, capture_output=True, text=True,
+        )
+        lines = [
+            ln for ln in p.stdout.strip().splitlines()
+            if ln.startswith("{")
+        ]
+        rec = json.loads(lines[-1]) if lines else {}
+        rec.pop("obs_jsonl", None)
+        rec["_tag"] = tag
+        rec["_rc"] = p.returncode
+        if p.returncode:
+            rec["_stderr"] = p.stderr[-2000:]
+        return rec
+
+    heur = child("heuristic", {"COMBBLAS_PLAN_STORE": "0"})
+    cold = child("cold", {
+        "COMBBLAS_PLAN_STORE": store_dir, "COMBBLAS_TUNER_PROBE": "1",
+    })
+    warm = child("warm", {
+        "COMBBLAS_PLAN_STORE": store_dir, "COMBBLAS_TUNER_PROBE": "1",
+    })
+    warm_ms = warm.get("ms_per_spgemm") or 0
+    heur_ms = heur.get("ms_per_spgemm") or 0
+    out = {
+        "metric": f"spgemm_tuner_ab_{PATTERN}_scale{SCALE}{_EFTAG}"
+                  f"{_GRIDTAG}_warm_ms",
+        "value": warm_ms,
+        "unit": "ms",
+        "store_dir": store_dir,
+        "heuristic": heur,
+        "cold": cold,
+        "warm": warm,
+        # the acceptance gates, evaluated in-line:
+        "warm_store_hit": warm.get("plan_source") == "store",
+        "cold_probe_runs": (cold.get("tuner") or {}).get(
+            "probe_runs", -1
+        ),
+        "warm_probe_runs": (warm.get("tuner") or {}).get(
+            "probe_runs", -1
+        ),
+        "warm_vs_heuristic_speedup": (
+            round(heur_ms / warm_ms, 3) if warm_ms and heur_ms else None
+        ),
+    }
+    print(json.dumps(out), flush=True)
+
+
 def main():
+    if TUNER_AB:
+        return tuner_ab()
     if PR > 1 and os.environ.get("JAX_PLATFORMS", "") != "tpu":
         os.environ["XLA_FLAGS"] = (
             os.environ.get("XLA_FLAGS", "")
@@ -123,26 +222,117 @@ def main():
     # BENCH_KERNEL=auto: resolve the router's tier HERE (host counts
     # only — the axon D2H rule) and run that kernel below; the metric
     # name keeps the requested "auto" and the JSON carries the tier.
+    # Round 10: resolution follows the tuner precedence — plan store >
+    # env > probe (opt-in) > heuristic — via the SAME key builder the
+    # library router uses, so a store warmed here routes spgemm_auto
+    # and vice versa.
+    A = SpParMat.from_global_coo(
+        grid, ru, cu, np.ones(len(ru), np.float32), n, n
+    )
     kernel = KERNEL
     tier = None
     backend = None
+    plan_source = None
+    plan_key = None
+    store = None
+    from combblas_tpu.tuner import config as tuner_config
+    from combblas_tpu.tuner import store as tuner_store
+
+    store = tuner_store.get_store()
     if KERNEL in ("auto", "windowed"):
         from combblas_tpu.parallel.spgemm import resolve_spgemm_backend
 
         # COMBBLAS_SPGEMM_BACKEND=dot forces the 2D MXU path (the TPU
         # stand-in run on this CPU image); default follows the platform
         backend = resolve_spgemm_backend()
-    if KERNEL == "auto":
-        from combblas_tpu.parallel.spgemm import choose_tier_from_counts
-
-        lrA_, lcB_ = grid.local_rows(n), grid.local_cols(n)
-        tier = choose_tier_from_counts(
-            PLUS_TIMES, max(lrA_, lcB_), lrA_ * lcB_, grid.pr,
-            float(flops), backend, k_dim=grid.local_rows(n),
-            n_dim=lcB_,
+    if store is not None:
+        from combblas_tpu.parallel.spgemm import (
+            resolve_spgemm_backend as _resolve_be,
         )
+
+        # key under the RESOLVED backend even for forced kernels, so a
+        # recorded plan and the library router agree on the key
+        plan_key = tuner_store.plan_key_from_counts(
+            "plus_times", n, n, n, len(ru), len(ru),
+            backend or _resolve_be(), f"{grid.pr}x{grid.pc}",
+        )
+    plan_rec = None
+    if KERNEL == "auto":
+        rec = store.lookup(plan_key) if store is not None else None
+        if rec is not None and rec.tier not in (
+            "mxu", "windowed", "scan", "esc"
+        ):
+            rec = None  # the library's tier vetting, mirrored
+        if rec is not None:
+            tier, plan_source, plan_rec = rec.tier, "store", rec
+        elif tuner_config.env_tier() is not None:
+            tier, plan_source = tuner_config.env_tier(), "env"
+        elif store is not None and tuner_config.probe_enabled():
+            from combblas_tpu.tuner.probe import probe_spgemm
+
+            rec = probe_spgemm(
+                PLUS_TIMES, A, A, backend=backend, store=store,
+                key=plan_key,
+                host_coo_a=(ru, cu, np.ones(len(ru), np.float32)),
+            )
+            if rec is not None:
+                tier, plan_source = rec.tier, "probe"
+        if tier is None:
+            from combblas_tpu.parallel.spgemm import (
+                choose_tier_from_counts,
+            )
+
+            lrA_, lcB_ = grid.local_rows(n), grid.local_cols(n)
+            tier = choose_tier_from_counts(
+                PLUS_TIMES, max(lrA_, lcB_), lrA_ * lcB_, grid.pr,
+                float(flops), backend, k_dim=grid.local_rows(n),
+                n_dim=lcB_,
+            )
+            plan_source = "heuristic"
         obs.count("spgemm.auto.tier", tier=tier, sr="plus_times")
+        obs.count(
+            "spgemm.auto.plan_source", source=plan_source, tier=tier,
+            op="spgemm",
+        )
         kernel = tier
+    else:
+        plan_source = "arg"  # BENCH_KERNEL forced this rung
+
+    def provenance(**knobs):
+        """plan provenance fields for the output JSON (satellite 2)."""
+        p = {
+            "plan_source": plan_source,
+            "plan": {"tier": tier or kernel, "backend": backend,
+                     **knobs},
+        }
+        if store is not None:
+            p["tuner"] = store.stats()
+        return p
+
+    def record_plan(ms_per_spgemm, block_rows=None, block_cols=None):
+        """BENCH_PLAN_RECORD=1: persist this run's measured plan —
+        only if it BEATS the remembered cost (a forced-kernel seeding
+        sweep must converge on the cheapest plan regardless of sweep
+        order)."""
+        if not PLAN_RECORD or store is None or plan_key is None:
+            return
+        if kernel not in ("mxu", "windowed", "scan", "esc"):
+            return  # scanphased is a bench-only protocol, not a tier
+        prev = store.peek(plan_key)
+        if (
+            prev is not None
+            and prev.cost_s is not None
+            and prev.cost_s <= ms_per_spgemm / 1e3
+        ):
+            return
+        store.put(plan_key, tuner_store.PlanRecord(
+            tier=kernel, block_rows=block_rows, block_cols=block_cols,
+            ring=RING, pipeline=PIPELINE,
+            # record the dispatch the cost was MEASURED under (None
+            # would replay fused measurements as auto->blocked)
+            dispatch=DISPATCH if kernel == "windowed" else None,
+            cost_s=ms_per_spgemm / 1e3, source="bench",
+        ))
     if kernel == "scan":
         # exact output structure on host: out_capacity = nnz(A^2) — the
         # scan variant's accumulator scales with the OUTPUT, which is what
@@ -158,9 +348,6 @@ def main():
             )
             nnz_out = int((S @ S).nnz)
             ocap = 1 << int(np.ceil(np.log2(max(nnz_out, 2) * 1.05)))
-    A = SpParMat.from_global_coo(
-        grid, ru, cu, np.ones(len(ru), np.float32), n, n
-    )
 
     # All REPS chained inside ONE launch (per-launch dispatch through the
     # tunnel costs ~105ms-1.8s; see benchmarks/results/instrument_r2*).
@@ -193,15 +380,21 @@ def main():
         lrA = grid.local_rows(n)
         lcB = grid.local_cols(n)
         # KERNEL=auto already resolved (and obs-counted) the tier above;
-        # a direct BENCH_KERNEL=windowed request is its own tier
+        # a direct BENCH_KERNEL=windowed request is its own tier.
+        # Geometry precedence mirrors the library: bench knob > the
+        # store record's measured shape > the kernel default.
         tier = tier or "windowed"
-        block_rows = BLOCK_ROWS or default_block_rows(lrA, lcB)
+        rec_br = plan_rec.block_rows if plan_rec is not None else None
+        rec_bc = plan_rec.block_cols if plan_rec is not None else None
+        block_rows = BLOCK_ROWS or rec_br or default_block_rows(
+            lrA, lcB
+        )
         extra = {}
         if backend == "dot":
             # 2D B-column-windowed MXU form, sized host-only (axon D2H
             # rule): the 2D symbolic pass, the plan, and the panel slice
             # capacity all come from the COO before any upload.
-            block_cols = BLOCK_COLS or default_block_cols(
+            block_cols = BLOCK_COLS or rec_bc or default_block_cols(
                 grid.local_rows(n), lcB
             )
             # one TRUE-counts pass only: the dot backend never consumes
@@ -336,6 +529,32 @@ def main():
                     ring=RING, pipeline=PIPELINE,
                 )
 
+        if FIRST_TOUCH:
+            # FIRST call, compile included: the bounded first-touch
+            # gate of the building-block decomposition (run once per
+            # process with BENCH_DISPATCH=fused, once with =blocked)
+            t0 = time.perf_counter()
+            C, ov = mult(A)
+            jax.block_until_ready(C.vals)
+            t_first = time.perf_counter() - t0
+            out = {
+                "metric": (
+                    f"spgemm_AxA_{PATTERN}_scale{SCALE}{_EFTAG}"
+                    f"{_GRIDTAG}_windowed_firsttouch_{DISPATCH}_s"
+                ),
+                "value": round(t_first, 3),
+                "unit": "s",
+                "dispatch": DISPATCH,
+                "block_rows": block_rows,
+                "blocks": len(skip),
+                "out_nnz": int(jax.device_get(C.getnnz())),
+                "grid": f"{grid.pr}x{grid.pc}",
+                **provenance(block_rows=block_rows),
+            }
+            if obs.ENABLED:
+                out["obs_jsonl"] = obs.dump_jsonl()
+            print(json.dumps(out))
+            return
         C, ov = mult(A)  # warmup/compile
         jax.block_until_ready(C.vals)
         time.sleep(3)
@@ -344,6 +563,12 @@ def main():
             C, ov = mult(A)
         nnz_v = int(jax.device_get(C.getnnz()))  # barrier
         dt = time.perf_counter() - t0
+        record_plan(
+            dt / REPS * 1e3, block_rows=block_rows,
+            block_cols=(
+                extra.get("block_cols") if backend == "dot" else None
+            ),
+        )
         out = {
             "metric": (
                 f"spgemm_AxA_{PATTERN}_scale{SCALE}{_EFTAG}{_GRIDTAG}"
@@ -367,6 +592,7 @@ def main():
                 else extra["col_windows_skipped"]
             ),
             **extra,
+            **provenance(block_rows=block_rows),
         }
         if GOLDEN:
             # EXACT agreement with the A² golden: 0/1 adjacency counts
@@ -582,6 +808,7 @@ def main():
         _ = float(jax.device_get(out))  # barrier
         dt = time.perf_counter() - t0
         C = mult(A)
+    record_plan(dt / REPS * 1e3)
     out = {
         "metric": f"spgemm_AxA_{PATTERN}_scale{SCALE}{_EFTAG}{_GRIDTAG}_{KERNEL}{_RINGTAG}_MFLOPs",
         "value": round(flops * 2 * REPS / dt / 1e6, 2),
@@ -597,6 +824,7 @@ def main():
             if kernel == "scan"
             else 0
         ),
+        **provenance(),
     }
     from combblas_tpu import obs as _obs
 

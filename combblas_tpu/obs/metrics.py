@@ -167,6 +167,74 @@ event per stage at trace time (fields ``stage``,
 ``overlapped`` — whether the next rotation was issued early), so a
 trace export shows the planned comm/compute overlap structure of the
 compiled schedule.
+
+Autotuner / plan-store series (round 10 — the measured-cost plan store
+and micro-probe pass, docs/autotuning.md):
+
+===================================  =======  =========================
+name                                 kind     meaning
+===================================  =======  =========================
+``tuner.store.hits``                 counter  routing decisions served
+                                              from a remembered plan
+                                              (labels: ``op`` =
+                                              spgemm / spgemm3d)
+``tuner.store.misses``               counter  lookups with no matching
+                                              plan (probe or fallback
+                                              follows)
+``tuner.store.entries``              gauge    plans currently loaded
+                                              (labels: ``dir``); also
+                                              published by the
+                                              compile-cache provider
+                                              as ``compile_cache.
+                                              entries{cache=plans}`` —
+                                              one health surface for
+                                              both caches
+``tuner.store.invalid``              counter  corrupted / truncated /
+                                              schema-mismatched JSONL
+                                              lines skipped at load
+``tuner.store.write_errors``         counter  failed store appends
+                                              (read-only replica; the
+                                              in-memory plan still
+                                              routes)
+``tuner.probe.runs``                 counter  candidate rungs measured
+                                              by the micro-probe pass
+                                              (labels: ``tier``)
+``tuner.probe.seconds``              counter  cumulative timed probe
+                                              seconds (the obs-visible
+                                              probe cost)
+``tuner.probe.winner``               counter  probe passes won per
+                                              tier (labels: ``tier``)
+``tuner.probe.errors``               counter  candidate rungs that
+                                              faulted on the proxy
+                                              (dropped, not fatal)
+``tuner.probe.budget_exhausted``     counter  probe passes cut short
+                                              by the probe budget
+``tuner.store.rejected``             counter  key-matched records
+                                              DISCARDED at routing
+                                              (labels: ``reason`` =
+                                              tier / no_grid3 / dup) —
+                                              pair with ``hits`` to
+                                              read the true hit rate
+``spgemm.windowed.dispatch_conflict``  counter  ring requests that
+                                              overrode an explicit
+                                              blocked dispatch (ring
+                                              is fused-only; the more
+                                              specific ask wins)
+``spgemm.auto.plan_source``          counter  WHERE each routing came
+                                              from: labels ``source``
+                                              (arg / store / env /
+                                              probe / heuristic),
+                                              ``tier``, ``op``
+``spgemm.windowed.dispatch``         counter  windowed-tier program
+                                              decomposition per call:
+                                              labels ``mode`` (local /
+                                              fused / blocked — the
+                                              building-block default)
+===================================  =======  =========================
+
+The ``tuner.probe`` span wraps each probe pass (attrs ``sr``, proxy
+``dim``), so trace exports show probe cost inline with the product that
+paid it.
 """
 
 from __future__ import annotations

@@ -1024,16 +1024,57 @@ def spgemm3d(
     ``tier`` picks the per-layer local kernel: ``"esc"`` (default — the
     classic expand/sort/compress stage kernel, exact for every
     semiring) or ``"windowed"`` (the sort-free dense-window tier,
-    ``spgemm3d_windowed``); env ``COMBBLAS_SPGEMM3D_TIER`` overrides
-    when no argument is given.  The ESC sizing pass mirrors
-    ``EstPerProcessNnzSUMMA``'s role (ParFriends.h:1243); capacities
-    round to powers of two (clamped to the dense-tile bound) for
-    compile-cache reuse.
+    ``spgemm3d_windowed``).  Resolution follows the tuner precedence
+    (tuner/config.py): argument > plan store (``op="spgemm3d"``
+    records, written by benches/operators — the 3D entry has no probe
+    pass yet) > env ``COMBBLAS_SPGEMM3D_TIER`` > ``"esc"``.  The ESC
+    sizing pass mirrors ``EstPerProcessNnzSUMMA``'s role
+    (ParFriends.h:1243); capacities round to powers of two (clamped to
+    the dense-tile bound) for compile-cache reuse.
     """
-    import os
+    from .. import obs
+    from ..tuner import config as tuner_config
+    from ..tuner import store as tuner_store
 
+    plan_source = "arg" if tier is not None else None
     if tier is None:
-        tier = os.environ.get("COMBBLAS_SPGEMM3D_TIER") or "esc"
+        st = tuner_store.get_store()
+        # key construction costs host nnz readbacks (D2H syncs) — only
+        # pay it when the store actually holds plans (the 3D entry has
+        # no probe pass, so an empty store can never produce a hit)
+        if st is not None and st.entries() > 0:
+            rec = st.lookup(
+                tuner_store.spgemm3d_plan_key(
+                    sr, A, B,
+                    backend or tuner_config.env_backend() or "",
+                )
+            )
+            if rec is not None and rec.tier not in ("esc", "windowed"):
+                # a key-matched record with a non-3D tier is discarded
+                # — made visible, like the 2D router, so hits-vs-
+                # plan_source can't silently contradict
+                if obs.ENABLED:
+                    obs.count("tuner.store.rejected", reason="tier")
+                rec = None
+            if rec is not None:
+                tier = rec.tier
+                plan_source = "store"
+                if block_rows is None:
+                    block_rows = rec.block_rows
+                if block_cols is None:
+                    block_cols = rec.block_cols
+    if tier is None:
+        tier = tuner_config.env_tier3d()
+        if tier is not None:
+            plan_source = "env"
+    if tier is None:
+        tier = "esc"
+        plan_source = "heuristic"
+    if obs.ENABLED:
+        obs.count(
+            "spgemm.auto.plan_source", source=plan_source, tier=tier,
+            op="spgemm3d",
+        )
     assert tier in ("esc", "windowed"), tier
     if tier == "windowed":
         return spgemm3d_windowed(

@@ -2284,17 +2284,38 @@ def summa_spgemm_windowed_blocked(
 
 def resolve_spgemm_backend(backend: str | None = None) -> str:
     """Accumulate-backend resolution, shared by the router and the sized
-    entries: explicit argument > ``COMBBLAS_SPGEMM_BACKEND`` env > the
-    platform default (``dot`` on TPU — no scatter unit — ``scatter``
-    elsewhere)."""
-    import os
+    entries: explicit argument > ``COMBBLAS_SPGEMM_BACKEND`` env (parsed
+    by ``tuner.config``, the one knob parser) > the platform default
+    (``dot`` on TPU — no scatter unit — ``scatter`` elsewhere)."""
+    from ..tuner import config as tuner_config
 
     if backend is None:
-        backend = os.environ.get("COMBBLAS_SPGEMM_BACKEND") or None
+        backend = tuner_config.env_backend()
     if backend is None:
         backend = "dot" if jax.default_backend() == "tpu" else "scatter"
     assert backend in ("dot", "scatter"), backend
     return backend
+
+
+def bucket_plan_caps(flop_caps, out_caps):
+    """Pow2-round a windowed plan's capacities (1D int tuples or the 2D
+    nested form) so per-block building-block programs share compiles:
+    two blocks — or two PRODUCTS inside one shape bucket — whose caps
+    round to the same powers of two hit one executable instead of
+    compiling per exact count.  Caps are upper bounds, so rounding UP
+    is always safe (≤2x extraction slots); callers that know the dense
+    block geometry re-impose the cells clamp afterwards (the pow2 round
+    can exceed a tail block's dense bound — see ``spgemm_windowed``).
+    This is the r7/r9 per-block-program lesson generalized to the
+    default path (disable with ``COMBBLAS_SPGEMM_BUCKET_CAPS=0``)."""
+    rnd = lambda x: 1 << (max(int(x), 1) - 1).bit_length()
+
+    def walk(t):
+        return tuple(
+            walk(x) if isinstance(x, tuple) else rnd(x) for x in t
+        )
+
+    return walk(flop_caps), walk(out_caps)
 
 
 def panel_cap_from_bnnz(bnnz, capacity: int) -> int:
@@ -2354,6 +2375,7 @@ def spgemm_windowed(
     oracle: bool = False,
     ring: bool = False,
     pipeline: bool = True,
+    dispatch: str | None = None,
 ) -> SpParMat:
     """Sized entry for the windowed tier: device symbolic pass →
     ``windowed_plan`` (scatter, 1D) or ``windowed_plan_2d`` (dot, 2D) →
@@ -2361,6 +2383,21 @@ def spgemm_windowed(
     readback-poisoned hardware size on host via
     ``summa_rowblock_flops_host`` / ``summa_window_flops_host`` +
     ``summa_window_bnnz_host`` instead).
+
+    ``dispatch`` (argument > env ``COMBBLAS_SPGEMM_DISPATCH`` >
+    ``"auto"``) picks the multi-device program decomposition for the
+    scatter backend: ``"auto"`` (default) routes any product with more
+    than one occupied row block through the BLOCKED building-block
+    dispatch (``summa_spgemm_windowed_blocked`` — one small fixed-shape
+    program per occupied block, caps pow2-bucketed so blocks share
+    compiles), which bounds both first-touch compile time and the live
+    set: no single XLA compile scales with the whole product (the
+    scale-17 54-minute fused-compile wall cannot recur).  ``"fused"``
+    forces the one-graph kernel (required by — and implied for — the
+    ``ring`` carousel schedules); ``"blocked"`` forces per-block
+    programs.  Single-device products already run per-block programs
+    (``local_spgemm_windowed``); the dot backend's multi-device path
+    has no blocked kernel yet and stays fused.
 
     ``oracle=True`` (dot, single device, inside the support-oracle
     envelope) replaces the clamped-flops out caps with the EXACT
@@ -2374,7 +2411,11 @@ def spgemm_windowed(
     schedule instead of the gathered one; ``pipeline=False`` pins the
     serial-chain control (see ``summa_spgemm_windowed``).
     """
+    from ..tuner import config as tuner_config
+
     backend = resolve_spgemm_backend(backend)
+    dispatch = tuner_config.resolve_dispatch(dispatch)
+    bucket = tuner_config.bucket_caps_enabled()
     if block_rows is None:
         block_rows = default_block_rows(A.local_rows, B.local_cols)
     chunk_w = WINDOWED_CHUNK_W
@@ -2412,11 +2453,35 @@ def spgemm_windowed(
                 # clamped-flops caps, observably (never silently)
                 if obs.ENABLED:
                     obs.count("spgemm.windowed.oracle_skipped")
+        if bucket:
+            # pow2 caps AFTER oracle tightening: the bucket keeps the
+            # compile-sharing property, the oracle keeps the skips;
+            # then re-impose the dense-window bound the round may have
+            # exceeded on tail blocks/windows (no slot can outnumber
+            # the window's cells)
+            flop_caps, out_caps = bucket_plan_caps(flop_caps, out_caps)
+            out_caps = tuple(
+                tuple(
+                    min(
+                        oc,
+                        max(min(block_rows,
+                                A.local_rows - g * block_rows), 1)
+                        * max(min(block_cols,
+                                  B.local_cols - h * block_cols), 1),
+                    )
+                    for h, oc in enumerate(row)
+                )
+                for g, row in enumerate(out_caps)
+            )
         panel_cap = panel_cap_from_bnnz(
             host_value(summa_window_bnnz(B, block_cols)),
             int(B.capacity),
         )
         if obs.ENABLED:
+            obs.count(
+                "spgemm.windowed.dispatch",
+                mode="local" if A.grid.size == 1 else "fused",
+            )
             nsk = sum(sum(row) for row in skip)
             obs.count("spgemm.windowed.col_windows_skipped", nsk)
             npk = len(packed_windows_2d(skip))
@@ -2487,6 +2552,48 @@ def spgemm_windowed(
     flop_caps, out_caps, skip = windowed_plan(
         pb, pt, block_rows, A.local_rows, B.local_cols, slack=slack
     )
+    if bucket:
+        flop_caps, out_caps = bucket_plan_caps(flop_caps, out_caps)
+        # dense-block bound re-imposed after the pow2 round (tail
+        # blocks: rb * lcB may not be a power of two)
+        out_caps = tuple(
+            min(
+                oc,
+                max(min(block_rows, A.local_rows - g * block_rows), 1)
+                * B.local_cols,
+            )
+            for g, oc in enumerate(out_caps)
+        )
+    # the building-block decomposition rule (round 10): any distributed
+    # scatter product with >1 occupied block defaults to per-block
+    # programs — the ring carousel is a fused-only schedule, so a ring
+    # request keeps the fused graph even against dispatch="blocked"
+    # (the more specific schedule ask wins; the conflict is counted)
+    if ring and dispatch == "blocked":
+        if obs.ENABLED:
+            obs.count("spgemm.windowed.dispatch_conflict")
+        dispatch = "fused"
+    use_blocked = (
+        A.grid.size > 1
+        and backend == "scatter"
+        and (
+            dispatch == "blocked"
+            or (
+                dispatch == "auto"
+                and not ring
+                and len(packed_windows(skip)) > 1
+            )
+        )
+    )
+    if obs.ENABLED:
+        obs.count(
+            "spgemm.windowed.dispatch",
+            mode=(
+                "blocked" if use_blocked
+                else "local" if A.grid.size == 1
+                else "fused"
+            ),
+        )
     if obs.ENABLED:
         obs.count("spgemm.windowed.windows_skipped", sum(skip))
         npk = len(packed_windows(skip))
@@ -2507,6 +2614,14 @@ def spgemm_windowed(
         # shard_map graph measures >2x slower on XLA:CPU — see
         # local_spgemm_windowed)
         C, overflow = local_spgemm_windowed(
+            sr, A, B, block_rows=block_rows, flop_caps=flop_caps,
+            out_caps=out_caps, skip=skip, chunk_w=chunk_w,
+        )
+    elif use_blocked:
+        # distributed building-block dispatch: one small shard_map
+        # program per occupied row block, bucketed caps shared — the
+        # default that bounds first-touch compile AND the live set
+        C, overflow = summa_spgemm_windowed_blocked(
             sr, A, B, block_rows=block_rows, flop_caps=flop_caps,
             out_caps=out_caps, skip=skip, chunk_w=chunk_w,
         )
@@ -2747,11 +2862,17 @@ def spgemm_auto(
     oracle: bool = False,
     assume_unique: bool = False,
     grid3=None,
-    ring: bool = False,
-    pipeline: bool = True,
+    ring: bool | None = None,
+    pipeline: bool | None = None,
+    dispatch: str | None = None,
 ) -> SpParMat:
     """Auto-tiered sparse-output SpGEMM: route (shape, density, semiring)
     through the fastest applicable kernel instead of defaulting to ESC.
+
+    ``ring``/``pipeline`` are tri-state here (None = "let the resolved
+    plan decide"): an EXPLICIT True/False always beats a remembered
+    record's schedule flags — the arg > store precedence holds for
+    every knob, not just the tier.
 
     The ladder (see docs/spgemm.md and ``choose_spgemm_tier``):
 
@@ -2764,11 +2885,22 @@ def spgemm_auto(
                  removes the ESC sort, on every backend;
       "scan"/"esc"  output-bounded / classic ESC (general fallback).
 
-    ``tier`` (or env ``COMBBLAS_SPGEMM_TIER``) forces a rung;
+    Routing resolution (the precedence documented in
+    ``tuner/config.py``): explicit ``tier`` argument > **plan store**
+    (a measured plan remembered for this (shape bucket, density band,
+    semiring, backend, grid) — ``combblas_tpu.tuner.store``, disabled
+    via ``COMBBLAS_PLAN_STORE=0``) > env ``COMBBLAS_SPGEMM_TIER`` >
+    the micro-probe pass (opt-in ``COMBBLAS_TUNER_PROBE=1``: measures
+    the admissible rungs on a bounded proxy and persists the winner) >
+    ``choose_spgemm_tier``'s heuristic ladder.  The winning source is
+    the labeled ``spgemm.auto.plan_source`` counter.
+
     ``backend`` (or env ``COMBBLAS_SPGEMM_BACKEND``) forces the
     windowed accumulate backend; ``block_rows``/``block_cols`` (or envs
     ``COMBBLAS_SPGEMM_BLOCK_ROWS`` / ``COMBBLAS_SPGEMM_BLOCK_COLS``)
-    override the window geometry.  The chosen tier is recorded as the
+    override the window geometry; ``dispatch`` threads through to the
+    windowed tier's program decomposition (see ``spgemm_windowed``).
+    The chosen tier is recorded as the
     labeled ``spgemm.auto.tier`` counter, with
     ``spgemm.windowed.windows_skipped`` /
     ``spgemm.windowed.col_windows_skipped`` /
@@ -2791,25 +2923,107 @@ def spgemm_auto(
     backend, which densifies with the combining scatter
     (``densify_combine``) — absorbs duplicate COO entries exactly.
     """
-    import os
+    from ..tuner import config as tuner_config
+    from ..tuner import store as tuner_store
 
+    plan_source = "arg" if tier is not None else None
+    store = key = rec = None
     if tier is None:
-        tier = os.environ.get("COMBBLAS_SPGEMM_TIER") or None
+        # resolution precedence (documented once in tuner/config.py):
+        #   arg > plan store > env > probe-on-miss > heuristic
+        store = tuner_store.get_store()
+        # the key costs one memoized host-nnz readback per operand —
+        # never pay it when the store has nothing to offer AND no probe
+        # would persist a plan under it (the axon D2H rule)
+        if store is not None and (
+            store.entries() > 0 or tuner_config.probe_enabled()
+        ):
+            key = tuner_store.spgemm_plan_key(
+                sr, A, B, resolve_spgemm_backend(backend), grid3=grid3
+            )
+            rec = store.lookup(key)
+        # vet the remembered plan before trusting it — a rejected
+        # record degrades down the precedence chain (obs: the raw
+        # ``tuner.store.hits`` already counted the key match, so the
+        # discard is made visible as ``tuner.store.rejected``)
+        if rec is not None and rec.tier not in (
+            "mxu", "windowed", "scan", "esc", "windowed3d"
+        ):
+            # e.g. a serve-lane record under a hand-mangled spgemm key
+            if obs.ENABLED:
+                obs.count("tuner.store.rejected", reason="tier")
+            rec = None
+        if rec is not None and rec.tier == "windowed3d" and grid3 is None:
+            # a 3D plan is unusable without a layered mesh
+            if obs.ENABLED:
+                obs.count("tuner.store.rejected", reason="no_grid3")
+            rec = None
+        if rec is not None and rec.tier == "mxu" and not assume_unique:
+            # a remembered plan never bypasses the mxu unique-entries
+            # precondition: the record was measured on SOME input in
+            # this bucket, not necessarily a duplicate-free one
+            if coo_has_duplicates(A) or (
+                B is not A and coo_has_duplicates(B)
+            ):
+                if obs.ENABLED:
+                    obs.count("spgemm.auto.dedup_fallback", sr=sr.name)
+                    obs.count("tuner.store.rejected", reason="dup")
+                rec = None
+        if rec is not None:
+            tier = rec.tier
+            plan_source = "store"
+            if block_rows is None:
+                block_rows = rec.block_rows
+            if block_cols is None:
+                block_cols = rec.block_cols
+            if dispatch is None:
+                dispatch = rec.dispatch
+            # explicit args beat the record (tri-state: None = defer)
+            if ring is None:
+                ring = rec.ring
+            if pipeline is None:
+                pipeline = rec.pipeline
+    # env geometry fills in AFTER the store record (precedence: a
+    # measured plan's block shape beats a fleet-wide env default)
     if block_rows is None:
-        env_br = os.environ.get("COMBBLAS_SPGEMM_BLOCK_ROWS")
-        # "0" means default too (the bench knobs' convention)
-        block_rows = (int(env_br) or None) if env_br else None
+        block_rows = tuner_config.env_block_rows()
     if block_cols is None:
-        env_bc = os.environ.get("COMBBLAS_SPGEMM_BLOCK_COLS")
-        block_cols = (int(env_bc) or None) if env_bc else None
+        block_cols = tuner_config.env_block_cols()
+    if tier is None:
+        tier = tuner_config.env_tier()
+        if tier is not None:
+            plan_source = "env"
+    if (
+        tier is None
+        and store is not None
+        and grid3 is None  # probing covers the 2D ladder
+        and tuner_config.probe_enabled()
+    ):
+        from ..tuner.probe import probe_spgemm
+
+        rec = probe_spgemm(
+            sr, A, B, backend=resolve_spgemm_backend(backend),
+            store=store, key=key,
+        )
+        if rec is not None:
+            tier = rec.tier
+            plan_source = "probe"
     if tier is None:
         tier = choose_spgemm_tier(
             sr, A, B, backend=backend, assume_unique=assume_unique,
             grid3=grid3,
         )
+        plan_source = "heuristic"
+    # tri-state schedule flags -> concrete (the kernel defaults)
+    ring = False if ring is None else bool(ring)
+    pipeline = True if pipeline is None else bool(pipeline)
     assert tier in ("mxu", "windowed", "scan", "esc", "windowed3d"), tier
     if obs.ENABLED:
         obs.count("spgemm.auto.tier", tier=tier, sr=sr.name)
+        obs.count(
+            "spgemm.auto.plan_source", source=plan_source, tier=tier,
+            op="spgemm",
+        )
     with obs.span("spgemm.auto", sr=sr.name, tier=tier):
         if tier == "esc":
             return spgemm(sr, A, B, slack)
@@ -2823,7 +3037,7 @@ def spgemm_auto(
                 sr, A, B, block_rows=block_rows, block_cols=block_cols,
                 backend=backend, mode=mode, slack=slack,
                 interpret=interpret, oracle=oracle, ring=ring,
-                pipeline=pipeline,
+                pipeline=pipeline, dispatch=dispatch,
             )
         if tier == "windowed3d":
             # the layered route: 2D operands → 3D splits (on-device

@@ -1,0 +1,154 @@
+"""The ONE place the ``COMBBLAS_SPGEMM_*`` / tuner knobs are parsed.
+
+Before round 10 the env parsing was scattered: ``spgemm_auto`` read
+``COMBBLAS_SPGEMM_TIER`` / ``_BLOCK_ROWS`` / ``_BLOCK_COLS`` inline,
+``resolve_spgemm_backend`` read ``COMBBLAS_SPGEMM_BACKEND``,
+``mesh3d.spgemm3d`` read ``COMBBLAS_SPGEMM3D_TIER``, and every bench
+re-implemented the same ``or None`` / ``"0" means default`` conventions.
+This module centralizes the parsing so the tuner, the router, and the
+benches all read identical semantics.
+
+Resolution precedence (documented ONCE, here):
+
+    explicit argument  >  plan store  >  env var  >  heuristic
+
+* **argument** — a caller passing ``tier=`` / ``backend=`` /
+  ``block_rows=`` etc. always wins (tests and forced benches).
+* **plan store** — a measured plan persisted by the micro-probe pass
+  (``combblas_tpu.tuner.store``); this is what makes tier choice
+  reproducible across processes.  Disable with ``COMBBLAS_PLAN_STORE=0``.
+* **env var** — the classic fleet-wide override knobs below.
+* **heuristic** — ``choose_spgemm_tier``'s hand-tuned ladder, the
+  fallback when nothing above decided.  The opt-in micro-probe pass
+  (``COMBBLAS_TUNER_PROBE=1``) runs at this point — on a store miss
+  with no arg/env override it MEASURES the admissible rungs and writes
+  the winner back, so the heuristic is consulted only when probing is
+  disabled or over budget.
+
+Env-var conventions shared by every knob: unset or empty means
+"default"; for the integer knobs ``"0"`` also means default (the bench
+convention since round 6).
+"""
+
+from __future__ import annotations
+
+import os
+
+#: SpGEMM routing / geometry knobs (round-6/7/9 compatible names).
+ENV_TIER = "COMBBLAS_SPGEMM_TIER"
+ENV_BACKEND = "COMBBLAS_SPGEMM_BACKEND"
+ENV_BLOCK_ROWS = "COMBBLAS_SPGEMM_BLOCK_ROWS"
+ENV_BLOCK_COLS = "COMBBLAS_SPGEMM_BLOCK_COLS"
+ENV_TIER3D = "COMBBLAS_SPGEMM3D_TIER"
+#: Windowed multi-device dispatch: fused | blocked | auto (default).
+ENV_DISPATCH = "COMBBLAS_SPGEMM_DISPATCH"
+#: Pow2-bucket the per-block plan capacities ("0" disables).
+ENV_BUCKET_CAPS = "COMBBLAS_SPGEMM_BUCKET_CAPS"
+
+#: Plan-store knobs (round 10).
+ENV_PLAN_STORE = "COMBBLAS_PLAN_STORE"      # dir | "0"/"off" disables
+ENV_PROBE = "COMBBLAS_TUNER_PROBE"          # "1" enables the probe pass
+ENV_PROBE_BUDGET = "COMBBLAS_TUNER_PROBE_BUDGET_S"
+ENV_PROBE_MAX_DIM = "COMBBLAS_TUNER_PROBE_MAX_DIM"
+
+#: Default probe budget: total measured seconds across all candidate
+#: rungs for ONE store miss (compiles excluded from the budget check
+#: only insofar as the first candidate always completes).
+DEFAULT_PROBE_BUDGET_S = 30.0
+#: Proxy dimension cap for the downsampled probe operands.
+DEFAULT_PROBE_MAX_DIM = 2048
+
+
+def _str_env(name: str) -> str | None:
+    v = os.environ.get(name)
+    return v if v else None
+
+
+def _int_env(name: str) -> int | None:
+    """Unset, empty, and "0" all mean "use the default" (the bench
+    knob convention: BENCH_BLOCK_ROWS=0 falls through)."""
+    v = os.environ.get(name)
+    if not v:
+        return None
+    return int(v) or None
+
+
+def env_tier() -> str | None:
+    return _str_env(ENV_TIER)
+
+
+def env_backend() -> str | None:
+    return _str_env(ENV_BACKEND)
+
+
+def env_block_rows() -> int | None:
+    return _int_env(ENV_BLOCK_ROWS)
+
+
+def env_block_cols() -> int | None:
+    return _int_env(ENV_BLOCK_COLS)
+
+
+def env_tier3d() -> str | None:
+    return _str_env(ENV_TIER3D)
+
+
+def env_dispatch() -> str | None:
+    return _str_env(ENV_DISPATCH)
+
+
+def bucket_caps_enabled() -> bool:
+    """Pow2 cap bucketing is ON by default: it is what lets per-block
+    building-block programs share compiles across blocks and across
+    products inside one shape bucket (the bounded first-touch-compile
+    half of round 10)."""
+    return os.environ.get(ENV_BUCKET_CAPS, "1") not in ("", "0")
+
+
+def resolve_dispatch(dispatch: str | None = None) -> str:
+    """Windowed-tier dispatch: argument > env > ``"auto"``.
+
+    ``auto`` routes multi-device scatter products with more than one
+    occupied row block through the BLOCKED building-block dispatch
+    (``summa_spgemm_windowed_blocked``) so no single XLA compile scales
+    with the whole product; ``fused`` forces the one-graph kernel (the
+    carousel/ring schedules live there); ``blocked`` forces per-block
+    programs."""
+    if dispatch is None:
+        dispatch = env_dispatch()
+    if dispatch is None:
+        dispatch = "auto"
+    assert dispatch in ("auto", "fused", "blocked"), dispatch
+    return dispatch
+
+
+def store_dir() -> str | None:
+    """The plan-store directory, or ``None`` when the store is disabled.
+
+    ``COMBBLAS_PLAN_STORE``: a path uses that dir; ``0``/``off``
+    disables the store entirely.  Unset: the sibling of the XLA compile
+    cache dir (``utils/compile_cache.py`` — ``.plan_store`` next to
+    ``.jax_cache``), so a fleet that ships its compile cache ships its
+    plans with the same rsync."""
+    v = os.environ.get(ENV_PLAN_STORE)
+    if v is not None:
+        if v.strip().lower() in ("", "0", "off", "none"):
+            return None
+        return os.path.abspath(v)
+    from ..utils import compile_cache
+
+    return compile_cache.plan_store_dir()
+
+
+def probe_enabled() -> bool:
+    return os.environ.get(ENV_PROBE, "0") not in ("", "0")
+
+
+def probe_budget_s() -> float:
+    v = os.environ.get(ENV_PROBE_BUDGET)
+    return float(v) if v else DEFAULT_PROBE_BUDGET_S
+
+
+def probe_max_dim() -> int:
+    v = os.environ.get(ENV_PROBE_MAX_DIM)
+    return int(v) if v else DEFAULT_PROBE_MAX_DIM

@@ -1,0 +1,423 @@
+"""Measured-cost plan store: remembered SpGEMM routing decisions.
+
+The reference CombBLAS picks kernels from compile-time functors and
+hand-reasoned flop models; our port's ``choose_spgemm_tier`` inherited
+that spirit — every measured win was per-session folklore.  The store
+replaces re-derivation with REMEMBERED MEASUREMENTS: plans keyed by
+(shape bucket, density band, semiring, backend, grid / grid3) hold the
+chosen tier, window geometry, schedule flags, and the measured cost,
+persisted as schema-versioned JSONL next to the XLA compile cache so a
+warm fleet ships plans to new replicas alongside compiled executables.
+
+File format — one JSON object per line, append-only (later lines win):
+
+    {"v": "combblas_tpu.plans/v1", "key": {...}, "plan": {...}}
+
+Robustness contract: a corrupted, truncated, or schema-mismatched line
+is IGNORED (counted in ``stats()['invalid_lines']`` and, under obs, the
+``tuner.store.invalid`` counter) and routing falls back to the next
+rung of the precedence chain — a bad plans file can never take the
+library down.  Writes append a fully formed line (single ``write``
+call), so a torn write from a dying process truncates to an invalid
+LAST line, not a poisoned store.
+
+The store also remembers SERVE WARMUP LANES: the (kind, width) plan
+cache entries a serving process actually used, so a fresh replica's
+``GraphEngine.warmup()`` pre-traces exactly the lanes the fleet serves
+(zero steady-state retraces without re-measuring).
+
+Host-side counters (``stats()``) are plain ints and always live; the
+obs mirrors (``tuner.store.{hits,misses,entries}`` ...) cost nothing
+when telemetry is disabled.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import os
+import threading
+
+import numpy as np
+
+from .. import obs
+from . import config
+
+#: JSONL schema tag — bump on any incompatible key/plan layout change;
+#: records carrying another tag are ignored at load (never guessed at).
+SCHEMA = "combblas_tpu.plans/v1"
+
+_TIERS = ("mxu", "windowed", "scan", "esc", "windowed3d", "serve")
+
+
+def shape_bucket(dim: int) -> int:
+    """Pow2 shape bucket: ceil(log2(dim)).  Two products whose global
+    dims round to the same pow2 share plans (and, with bucketed caps,
+    compiled building blocks)."""
+    return max(int(dim) - 1, 0).bit_length()
+
+
+def density_band(nnz: int, dim: int) -> int:
+    """Log2 band of the average degree (nnz per row): the density axis
+    of the plan key.  Clamped so pathological inputs can't mint
+    unbounded key cardinality."""
+    deg = max(int(nnz), 1) / max(int(dim), 1)
+    return int(min(max(round(math.log2(max(deg, 2.0 ** -8))), -8), 48))
+
+
+@dataclasses.dataclass(frozen=True)
+class PlanKey:
+    """What a plan is keyed by.  ``op`` distinguishes the 2D router
+    ("spgemm"), the 3D entry ("spgemm3d"), and serve warmup lane sets
+    ("serve"); ``grid3`` is "" for 2D products."""
+
+    op: str
+    shape: tuple[int, int, int]   # shape buckets of (m, k, n)
+    band: tuple[int, int]         # density bands of (A, B)
+    sr: str
+    backend: str
+    grid: str                     # "pr x pc", e.g. "2x2"
+    grid3: str = ""               # "L x pr x pc" for 3D, else ""
+    platform: str = ""            # jax.default_backend()
+
+    def to_json(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["shape"] = list(self.shape)
+        d["band"] = list(self.band)
+        return d
+
+    @staticmethod
+    def from_json(d: dict) -> "PlanKey":
+        return PlanKey(
+            op=str(d["op"]),
+            shape=tuple(int(x) for x in d["shape"]),
+            band=tuple(int(x) for x in d["band"]),
+            sr=str(d["sr"]),
+            backend=str(d["backend"]),
+            grid=str(d["grid"]),
+            grid3=str(d.get("grid3", "")),
+            platform=str(d.get("platform", "")),
+        )
+
+
+@dataclasses.dataclass
+class PlanRecord:
+    """One remembered decision: the winning tier plus the knobs it was
+    measured with and the measured cost.  ``block_rows``/``block_cols``
+    of ``None`` mean "the kernel default for this shape" (the probe
+    records what it actually ran).  ``lanes`` is the serve-warmup
+    variant's payload ((kind, width) pairs); spgemm records leave it
+    empty."""
+
+    tier: str
+    block_rows: int | None = None
+    block_cols: int | None = None
+    ring: bool = False
+    pipeline: bool = True
+    dispatch: str | None = None
+    mode: str | None = None
+    cost_s: float | None = None
+    source: str = "probe"          # probe | manual | bench
+    probe_dim: int | None = None   # proxy dimension the cost came from
+    lanes: tuple = ()
+
+    def to_json(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["lanes"] = [list(x) for x in self.lanes]
+        return d
+
+    @staticmethod
+    def from_json(d: dict) -> "PlanRecord":
+        tier = str(d["tier"])
+        if tier not in _TIERS:
+            raise ValueError(f"unknown tier {tier!r}")
+        disp = d.get("dispatch")
+        if disp is not None and disp not in ("auto", "fused", "blocked"):
+            # vetted at LOAD time so a schema-valid but hand-mangled
+            # line is skipped as invalid, never asserted on at routing
+            raise ValueError(f"unknown dispatch {disp!r}")
+        br = d.get("block_rows")
+        bc = d.get("block_cols")
+        return PlanRecord(
+            tier=tier,
+            block_rows=None if br is None else int(br),
+            block_cols=None if bc is None else int(bc),
+            ring=bool(d.get("ring", False)),
+            pipeline=bool(d.get("pipeline", True)),
+            dispatch=d.get("dispatch"),
+            mode=d.get("mode"),
+            cost_s=(
+                None if d.get("cost_s") is None else float(d["cost_s"])
+            ),
+            source=str(d.get("source", "probe")),
+            probe_dim=(
+                None if d.get("probe_dim") is None
+                else int(d["probe_dim"])
+            ),
+            lanes=tuple(
+                (str(k), int(w)) for k, w in d.get("lanes", ())
+            ),
+        )
+
+
+class PlanStore:
+    """Load-once, append-on-write JSONL plan store (threadsafe)."""
+
+    def __init__(self, path: str):
+        #: Directory holding ``plans.jsonl``.
+        self.path = os.path.abspath(path)
+        self.file = os.path.join(self.path, "plans.jsonl")
+        self._lock = threading.Lock()
+        self._plans: dict[PlanKey, PlanRecord] = {}
+        self._hits = 0
+        self._misses = 0
+        self._invalid = 0
+        self._probe_runs = 0
+        self._probe_seconds = 0.0
+        self._load()
+        if obs.ENABLED:
+            obs.gauge("tuner.store.entries", len(self._plans),
+                      dir=self.path)
+
+    # -- persistence -------------------------------------------------------
+
+    def _load(self) -> None:
+        try:
+            with open(self.file, encoding="utf-8") as f:
+                lines = f.readlines()
+        except OSError:
+            return  # no store yet: every lookup is a miss
+        for line in lines:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                d = json.loads(line)
+                if d.get("v") != SCHEMA:
+                    raise ValueError(f"schema {d.get('v')!r}")
+                key = PlanKey.from_json(d["key"])
+                rec = PlanRecord.from_json(d["plan"])
+            except (ValueError, KeyError, TypeError):
+                # corrupted / truncated / wrong-schema line: count it,
+                # skip it, keep loading — the robustness contract
+                self._invalid += 1
+                if obs.ENABLED:
+                    obs.count("tuner.store.invalid")
+                continue
+            self._plans[key] = rec  # append-only log: later lines win
+
+    def _append(self, key: PlanKey, rec: PlanRecord) -> None:
+        line = json.dumps(
+            {"v": SCHEMA, "key": key.to_json(), "plan": rec.to_json()}
+        ) + "\n"
+        try:
+            os.makedirs(self.path, exist_ok=True)
+            # one write call: a torn write truncates the LAST line,
+            # which the loader then skips as invalid
+            with open(self.file, "a", encoding="utf-8") as f:
+                f.write(line)
+        except OSError:
+            # read-only replica: the in-memory plan still routes
+            if obs.ENABLED:
+                obs.count("tuner.store.write_errors")
+
+    # -- lookup / record ---------------------------------------------------
+
+    def lookup(self, key: PlanKey) -> PlanRecord | None:
+        with self._lock:
+            rec = self._plans.get(key)
+            if rec is None:
+                self._misses += 1
+            else:
+                self._hits += 1
+        if obs.ENABLED:
+            obs.count(
+                "tuner.store.misses" if rec is None
+                else "tuner.store.hits",
+                op=key.op,
+            )
+        return rec
+
+    def peek(self, key: PlanKey) -> PlanRecord | None:
+        """Lookup WITHOUT hit/miss accounting — for store maintenance
+        (e.g. a bench deciding whether its measurement beats the
+        remembered one), not routing."""
+        with self._lock:
+            return self._plans.get(key)
+
+    def put(self, key: PlanKey, rec: PlanRecord,
+            persist: bool = True) -> None:
+        with self._lock:
+            self._plans[key] = rec
+        if persist:
+            self._append(key, rec)
+        if obs.ENABLED:
+            obs.gauge("tuner.store.entries", len(self._plans),
+                      dir=self.path)
+
+    def add_serve_lane(self, key: PlanKey, kind: str,
+                       width: int) -> bool:
+        """Merge one (kind, width) into the serve-lane record for
+        ``key``; returns True (and persists) iff the lane is new."""
+        lane = (str(kind), int(width))
+        with self._lock:
+            rec = self._plans.get(key)
+            if rec is None:
+                rec = PlanRecord(tier="serve", source="serve")
+                self._plans[key] = rec
+            if lane in rec.lanes:
+                return False
+            rec.lanes = tuple(sorted(set(rec.lanes) | {lane}))
+        self._append(key, rec)
+        return True
+
+    def serve_lanes(self, key: PlanKey) -> tuple:
+        with self._lock:
+            rec = self._plans.get(key)
+            return rec.lanes if rec is not None else ()
+
+    # -- bookkeeping -------------------------------------------------------
+
+    def record_probe(self, runs: int, seconds: float) -> None:
+        with self._lock:
+            self._probe_runs += runs
+            self._probe_seconds += seconds
+
+    def entries(self) -> int:
+        with self._lock:
+            return len(self._plans)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "path": self.path,
+                "entries": len(self._plans),
+                "hits": self._hits,
+                "misses": self._misses,
+                "invalid_lines": self._invalid,
+                "probe_runs": self._probe_runs,
+                "probe_seconds": round(self._probe_seconds, 4),
+            }
+
+
+# -- process-wide store -----------------------------------------------------
+
+_store: PlanStore | None = None
+_store_path: str | None = None
+_store_lock = threading.Lock()
+
+
+def get_store() -> PlanStore | None:
+    """The process's plan store, or ``None`` when disabled
+    (``COMBBLAS_PLAN_STORE=0``).  The dir is re-resolved per call so a
+    test's ``monkeypatch.setenv`` takes effect without process-global
+    surgery; the loaded instance is cached per resolved path."""
+    global _store, _store_path
+    path = config.store_dir()
+    if path is None:
+        return None
+    with _store_lock:
+        if _store is None or _store_path != path:
+            _store = PlanStore(path)
+            _store_path = path
+        return _store
+
+
+def _reset_for_tests() -> None:
+    """Drop the cached instance so the next ``get_store`` reloads from
+    disk (TEST-ONLY: lets a test observe an on-disk mutation or a
+    changed env var within one process)."""
+    global _store, _store_path
+    with _store_lock:
+        _store = None
+        _store_path = None
+
+
+# -- key builders -----------------------------------------------------------
+
+
+def _host_nnz(M) -> int:
+    """Total live nnz of a distributed matrix as a host int, memoized
+    on the object (the ``coo_has_duplicates`` convention: one D2H sync
+    per matrix, ever — the readback is the expensive part on the
+    target chip)."""
+    cached = getattr(M, "_host_nnz_cache", None)
+    if cached is not None:
+        return cached
+    import jax
+
+    val = int(np.asarray(jax.device_get(M.getnnz())))
+    object.__setattr__(M, "_host_nnz_cache", val)
+    return val
+
+
+def plan_key_from_counts(
+    sr_name: str,
+    m: int, k: int, n: int,
+    nnz_a: int, nnz_b: int,
+    backend: str,
+    grid: str,
+    grid3: str = "",
+    op: str = "spgemm",
+    platform: str | None = None,
+) -> PlanKey:
+    """The canonical key from host-side counts — benches (which must
+    not touch the device to decide) and the matrix-based builder below
+    MUST agree, so both funnel through here."""
+    if platform is None:
+        import jax
+
+        platform = jax.default_backend()
+    return PlanKey(
+        op=op,
+        shape=(shape_bucket(m), shape_bucket(k), shape_bucket(n)),
+        band=(density_band(nnz_a, m), density_band(nnz_b, k)),
+        sr=sr_name,
+        backend=backend,
+        grid=grid,
+        grid3=grid3,
+        platform=platform,
+    )
+
+
+def spgemm_plan_key(sr, A, B, backend: str, grid3=None) -> PlanKey:
+    """Plan key for a 2D ``spgemm_auto`` product (one memoized host
+    nnz readback per operand)."""
+    g3 = (
+        f"{grid3.layers}x{grid3.pr}x{grid3.pc}"
+        if grid3 is not None else ""
+    )
+    return plan_key_from_counts(
+        sr.name, int(A.nrows), int(A.ncols), int(B.ncols),
+        _host_nnz(A), _host_nnz(B) if B is not A else _host_nnz(A),
+        backend, f"{A.grid.pr}x{A.grid.pc}", grid3=g3,
+    )
+
+
+def spgemm3d_plan_key(sr, A3, B3, backend: str) -> PlanKey:
+    """Plan key for the 3D entry (``mesh3d.spgemm3d``)."""
+    g = A3.grid
+    return plan_key_from_counts(
+        sr.name, int(A3.nrows), int(A3.ncols), int(B3.ncols),
+        _host_nnz(A3), _host_nnz(B3) if B3 is not A3 else _host_nnz(A3),
+        backend, f"{g.pr}x{g.pc}",
+        grid3=f"{g.layers}x{g.pr}x{g.pc}", op="spgemm3d",
+    )
+
+
+def serve_plan_key(engine) -> PlanKey:
+    """Key for a serving engine's warmup-lane record: the graph's shape
+    bucket + density band + grid (version-independent — hot-swapped
+    same-shape versions keep the same lane set)."""
+    v = engine.version
+    nnz = max(int(getattr(v, "nnz", -1)), 1)
+    return PlanKey(
+        op="serve",
+        shape=(shape_bucket(int(v.nrows)),
+               shape_bucket(int(v.ncols)), 0),
+        band=(density_band(nnz, int(v.nrows)), 0),
+        sr="",
+        backend="",
+        grid=f"{engine.grid.pr}x{engine.grid.pc}",
+    )
