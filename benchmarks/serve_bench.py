@@ -36,6 +36,16 @@ gates on: availability >= 95% of well-formed requests, ZERO stranded
 futures, zero post-swap retraces (same-shape versions: the plan cache
 must survive every swap), and all swaps applied. Reports availability
 %, ok-request p50/p99 latency, and per-swap latency.
+
+BENCH_SERVE_MUTATE=1 runs the MIXED READ/WRITE scenario instead
+(ISSUE 9): the read stream serves while a writer thread streams
+edge-churn batches through ``submit_update`` (the dynamic mutation
+lane, docs/dynamic.md), and gates on zero steady-state retraces, all
+merges incremental, and the counter-backed rebuild-amortization ratio
+(one measured full ``build_version`` / mean incremental merge) > 1.
+Reports p99 read latency under writes, merge mode counts, and
+rows-patched/rebucketed counters.  ``BENCH_SERVE_MUTATE_WRITES`` sets
+the update-batch count (default 24).
 """
 
 from __future__ import annotations
@@ -71,10 +81,11 @@ def _percentile(xs: list[float], q: float) -> float:
 
 
 def _setup(scale, edgefactor, width, nqueries, grid_shape, kinds,
-           widths):
+           widths, keep_coo=False):
     """Shared graph/stream/warmup setup: the chaos scenario must
     measure the SAME engine, stream, and warm plans the baseline
-    scenario does."""
+    scenario does.  ``keep_coo=True`` retains the host edge list (the
+    mutation lane's merge-state bootstrap — the mutate scenario)."""
     import numpy as np
 
     from combblas_tpu.parallel.grid import Grid
@@ -88,7 +99,9 @@ def _setup(scale, edgefactor, width, nqueries, grid_shape, kinds,
     # raw COO straight in: from_coo deduplicates internally (one
     # int64-key unique pass — doing it here too would double the sort)
     t0 = time.perf_counter()
-    engine = GraphEngine.from_coo(grid, rows, cols, n, kinds=kinds)
+    engine = GraphEngine.from_coo(
+        grid, rows, cols, n, kinds=kinds, keep_coo=keep_coo
+    )
     load_s = time.perf_counter() - t0
 
     # mixed query stream: alternating kinds over random reachable roots
@@ -346,9 +359,189 @@ def run_chaos(scale: int = SCALE, edgefactor: int = EDGEFACTOR,
     return out
 
 
+def run_mutate(scale: int = SCALE, edgefactor: int = EDGEFACTOR,
+               width: int = WIDTH, nqueries: int | None = None,
+               grid_shape=(2, 4), kinds=("bfs", "pagerank")) -> dict:
+    """BENCH_SERVE_MUTATE=1 — mixed read/write traffic (ISSUE 9): the
+    usual read stream through the threaded server WHILE a writer thread
+    streams edge-churn updates into ``submit_update``.  Measures p99
+    read latency under the mix and the rebuild-amortization counters,
+    and gates on:
+
+      * zero steady-state retraces (incremental merges preserve every
+        operand shape, so same-shape swaps keep the warm plans);
+      * >= 1 update merged, ALL incrementally (the writer churns edges
+        whose endpoints' degree classes have slack, the in-place path);
+      * incremental merge measurably cheaper than a full rebuild at
+        this delta fraction: ``amortization`` = (one measured full
+        ``build_version``) / (mean incremental merge latency) > 1,
+        counter-backed from ``stats()['updates']``.
+    """
+    import threading
+
+    import numpy as np
+
+    from combblas_tpu import obs
+    from combblas_tpu.serve import BackpressureError, ServeConfig
+
+    sidecar = obs.enable_sidecar("serve-mutate")
+    nqueries = (
+        int(os.environ.get("BENCH_SERVE_QUERIES", "256"))
+        if nqueries is None else nqueries
+    )
+    nwrites = int(os.environ.get("BENCH_SERVE_MUTATE_WRITES", "24"))
+
+    widths = tuple(sorted({1, 2, 4, 8, width}))
+    engine, rows, cols, _roots, stream, load_s, warmup_s = _setup(
+        scale, edgefactor, width, nqueries, grid_shape, kinds, widths,
+        keep_coo=True,
+    )
+    n = engine.nrows
+    r0, c0, _ = engine.version.host_coo
+    deg = np.asarray(engine.version.deg)
+
+    # rebuild baseline: one full from_coo-pipeline build of the SAME
+    # edge list — what every write batch would cost without the
+    # incremental merge (measured, not modeled)
+    t0 = time.perf_counter()
+    engine.build_version(rows, cols)
+    rebuild_s = time.perf_counter() - t0
+
+    # churn pairs whose endpoint degrees sit below their fine-ladder
+    # class width (+1 stays in class): provably the in-place path.
+    # DISJOINT pairs (each vertex in at most one) so no endpoint's
+    # degree drifts across batches out of its slack class — and O(pool)
+    # instead of materializing the O(pool^2) cross product
+    slack = np.isin(deg, (5, 7, 9, 10, 11, 13, 14, 15, 17, 18, 19))
+    present = set(zip(r0.tolist(), c0.tolist()))
+    pool = np.flatnonzero(slack).tolist()
+    pairs = []
+    for a, b in zip(pool[0::2], pool[1::2]):
+        if (a, b) not in present:
+            pairs.append((a, b))
+        if len(pairs) >= max(nwrites, 1):
+            break
+
+    cfg = ServeConfig(
+        lane_widths=widths, max_queue=max(4 * width, nqueries),
+        max_wait_s=0.005, update_flush=4, update_max_delay_s=0.01,
+    )
+    lat_of: dict = {}
+    mark = engine.trace_mark()
+    write_futs = []
+    write_rejects = 0
+
+    t0 = time.perf_counter()
+    with engine.serve(cfg) as srv:
+
+        def writer():
+            nonlocal write_rejects
+            # insert each slack pair, then delete it one batch later:
+            # real structural change per merge, degree classes stable
+            for k, (a, b) in enumerate(pairs + pairs):
+                op = "insert" if k < len(pairs) else "delete"
+                try:
+                    write_futs.append(srv.submit_update(
+                        [(op, a, b), (op, b, a)]
+                    ))
+                except BackpressureError:
+                    write_rejects += 1
+                time.sleep(0.001)
+
+        wt = threading.Thread(target=writer)
+        wt.start()
+        futs = []
+        for kind, root in stream:
+            ts = time.monotonic()
+            try:
+                f = srv.submit(kind, root)
+            except BackpressureError:
+                continue
+            f.add_done_callback(
+                lambda _f, ts=ts: lat_of.setdefault(
+                    _f, time.monotonic() - ts
+                )
+            )
+            futs.append(f)
+        for f in futs:
+            f.result(timeout=600)
+        wt.join(60)
+        for f in write_futs:
+            f.result(timeout=600)
+        stats = srv.stats()
+    wall_s = time.perf_counter() - t0
+
+    retraces = engine.retraces_since(mark)
+    upd = stats["updates"]
+    incr = upd["by_mode"].get("incremental", 0)
+    rebuilds = upd["by_mode"].get("rebuild", 0)
+    incr_s = upd["merge_s_by_mode"].get("incremental", 0.0)
+    mean_incr_s = incr_s / incr if incr else None
+    amortization = (
+        rebuild_s / mean_incr_s if mean_incr_s else None
+    )
+    lat = [lat_of[f] for f in futs if f in lat_of]
+    ok = bool(
+        retraces == 0
+        and upd["merges"] >= 1
+        and incr >= 1
+        and rebuilds == 0
+        and amortization is not None
+        and amortization > 1.0
+    )
+    out = {
+        "metric": "serve_mutate_amortization",
+        "unit": "rebuild_over_incremental",
+        "value": round(amortization, 2) if amortization else None,
+        "ok": ok,
+        "nqueries": len(futs),
+        "p50_read_ms": (
+            round(1e3 * _percentile(lat, 0.50), 2) if lat else None
+        ),
+        "p99_read_ms": (
+            round(1e3 * _percentile(lat, 0.99), 2) if lat else None
+        ),
+        "qps_under_writes": round(len(futs) / wall_s, 2),
+        "updates_submitted": upd["submitted"],
+        "update_merges": upd["merges"],
+        "merges_incremental": incr,
+        "merges_rebuild": rebuilds,
+        "mean_incremental_merge_ms": (
+            round(1e3 * mean_incr_s, 3) if mean_incr_s else None
+        ),
+        "full_rebuild_ms": round(1e3 * rebuild_s, 3),
+        "write_rejects": write_rejects,
+        "retraces_after_warmup": retraces,
+        "graph_version": stats["graph_version"],
+        "rows_patched": (
+            obs.registry.get_counter("dynamic.merge.rows_patched")
+            if obs.ENABLED else None
+        ),
+        "rows_rebucketed": (
+            obs.registry.get_counter("dynamic.merge.rows_rebucketed")
+            if obs.ENABLED else None
+        ),
+        "width": width,
+        "scale": scale,
+        "grid": list(grid_shape),
+        "kinds": list(kinds),
+        "load_s": round(load_s, 2),
+        "warmup_s": round(warmup_s, 2),
+    }
+    obs.gauge("serve.bench.mutate_amortization", amortization or 0.0)
+    if sidecar:
+        try:
+            out["obs_jsonl"] = obs.dump_jsonl()
+        except Exception as e:  # telemetry must never fail the bench
+            out["obs_error"] = str(e)
+    return out
+
+
 def main():
     if os.environ.get("BENCH_SERVE_CHAOS") == "1":
         out = run_chaos()
+    elif os.environ.get("BENCH_SERVE_MUTATE") == "1":
+        out = run_mutate()
     else:
         out = run()
     print(json.dumps(out), flush=True)

@@ -126,6 +126,7 @@ def run() -> dict:
 
     from combblas_tpu.tuner import config as tuner_config
     from combblas_tpu.tuner import store as tuner_store
+    from combblas_tpu.tuner.resolve import resolve_tier
 
     store = tuner_store.get_store()
 
@@ -154,21 +155,20 @@ def run() -> dict:
         # the one that counts hits and emits spgemm.auto.plan_source;
         # the mirror below (peek: no accounting) only fills the JSON.
         forced = None if KERNEL == "auto" else KERNEL
-        tier = forced
-        plan_source = "arg" if forced is not None else None
         cfg_key = tuner_store.plan_key_from_counts(
             "plus_times", n, n, n, len(ru), len(ru),
             tuner_config.env_backend() or "", f"{pr}x{pc}",
             grid3=f"{L}x{pr}x{pc}", op="spgemm3d",
         )
-        if tier is None:
-            rec = store.peek(cfg_key) if store is not None else None
-            if rec is not None and rec.tier in ("esc", "windowed"):
-                tier, plan_source = rec.tier, "store"
-            elif tuner_config.env_tier3d() is not None:
-                tier, plan_source = tuner_config.env_tier3d(), "env"
-            else:
-                tier, plan_source = "esc", "heuristic"
+        # the shared store > env > heuristic walk (tuner.resolve),
+        # account=False: peek only, no counters — the LIBRARY call
+        # below does the accounted resolution; this mirror just fills
+        # the provenance JSON (and now applies the same record vetting
+        # the library does)
+        tier, plan_source, _rec = resolve_tier(
+            cfg_key, op="spgemm3d", allowed=("esc", "windowed"),
+            heuristic="esc", tier=forced, store=store, account=False,
+        )
 
         def mult():
             return spgemm3d(PLUS_TIMES, A3, B3, tier=forced)

@@ -235,7 +235,6 @@ def main():
     plan_source = None
     plan_key = None
     store = None
-    from combblas_tpu.tuner import config as tuner_config
     from combblas_tpu.tuner import store as tuner_store
 
     store = tuner_store.get_store()
@@ -258,42 +257,39 @@ def main():
         )
     plan_rec = None
     if KERNEL == "auto":
-        rec = store.lookup(plan_key) if store is not None else None
-        if rec is not None and rec.tier not in (
-            "mxu", "windowed", "scan", "esc"
-        ):
-            rec = None  # the library's tier vetting, mirrored
-        if rec is not None:
-            tier, plan_source, plan_rec = rec.tier, "store", rec
-        elif tuner_config.env_tier() is not None:
-            tier, plan_source = tuner_config.env_tier(), "env"
-        elif store is not None and tuner_config.probe_enabled():
+        # ONE walk of the store > env > probe > heuristic chain,
+        # shared with spgemm3d_bench and vetted like the library
+        # router (round-11 satellite: the inline copies skipped the
+        # record vetting)
+        from combblas_tpu.tuner.resolve import resolve_tier
+
+        def _probe():
             from combblas_tpu.tuner.probe import probe_spgemm
 
-            rec = probe_spgemm(
+            return probe_spgemm(
                 PLUS_TIMES, A, A, backend=backend, store=store,
                 key=plan_key,
                 host_coo_a=(ru, cu, np.ones(len(ru), np.float32)),
             )
-            if rec is not None:
-                tier, plan_source = rec.tier, "probe"
-        if tier is None:
+
+        def _heuristic():
             from combblas_tpu.parallel.spgemm import (
                 choose_tier_from_counts,
             )
 
             lrA_, lcB_ = grid.local_rows(n), grid.local_cols(n)
-            tier = choose_tier_from_counts(
+            return choose_tier_from_counts(
                 PLUS_TIMES, max(lrA_, lcB_), lrA_ * lcB_, grid.pr,
                 float(flops), backend, k_dim=grid.local_rows(n),
                 n_dim=lcB_,
             )
-            plan_source = "heuristic"
-        obs.count("spgemm.auto.tier", tier=tier, sr="plus_times")
-        obs.count(
-            "spgemm.auto.plan_source", source=plan_source, tier=tier,
-            op="spgemm",
+
+        tier, plan_source, plan_rec = resolve_tier(
+            plan_key, op="spgemm",
+            allowed=("mxu", "windowed", "scan", "esc"),
+            heuristic=_heuristic, probe=_probe, store=store,
         )
+        obs.count("spgemm.auto.tier", tier=tier, sr="plus_times")
         kernel = tier
     else:
         plan_source = "arg"  # BENCH_KERNEL forced this rung

@@ -73,6 +73,10 @@ from . import obs
 # importing it costs nothing until an engine is built.
 from . import serve
 
+# Streaming graph mutation (DeltaBuffer + incremental apply_delta +
+# warm-restart refresh); see docs/dynamic.md. Host-side like serve.
+from . import dynamic
+
 __version__ = "0.1.0"
 
 __all__ = [
@@ -99,4 +103,6 @@ __all__ = [
     "obs",
     # query serving
     "serve",
+    # streaming mutation lane
+    "dynamic",
 ]

@@ -235,6 +235,88 @@ name                                 kind     meaning
 The ``tuner.probe`` span wraps each probe pass (attrs ``sr``, proxy
 ``dim``), so trace exports show probe cost inline with the product that
 paid it.
+
+Dynamic-graph mutation series (round 11 — delta buffers, incremental
+version builds, warm-restart recompute, the serve write lane;
+docs/dynamic.md):
+
+====================================  =========  =======================
+name                                  kind       meaning
+====================================  =========  =======================
+``dynamic.delta.depth``               gauge      ops pending in a
+                                                 ``DeltaBuffer``
+``dynamic.delta.ops``                 counter    ops admitted (labels:
+                                                 ``op`` = insert /
+                                                 delete / upsert)
+``dynamic.delta.batches``             counter    batches drained
+``dynamic.delta.age_s``               histogram  oldest-op age at drain
+                                                 (write-coalescing
+                                                 latency)
+``dynamic.state.bootstrap``           counter    merge states built
+                                                 from scratch (first
+                                                 ``apply_delta`` on a
+                                                 version without one)
+``dynamic.merge.applied``             counter    ``apply_delta`` calls,
+                                                 labels ``mode`` =
+                                                 incremental / rebuild
+                                                 (the amortization
+                                                 ratio's numerator and
+                                                 denominator)
+``dynamic.merge.spill``               counter    incremental attempts
+                                                 that fell back to a
+                                                 rebuild; labels
+                                                 ``reason`` (threshold /
+                                                 bucket_full / no_state
+                                                 / forced)
+``dynamic.merge.latency_s``           histogram  wall time of one
+                                                 ``apply_delta``
+``dynamic.merge.rows_patched``        counter    rows rewritten in
+                                                 place (degree class
+                                                 survived)
+``dynamic.merge.rows_rebucketed``     counter    rows that claimed a
+                                                 free slot in another
+                                                 degree class
+``dynamic.merge.edges_inserted``      counter    edges added by merges
+``dynamic.merge.edges_removed``       counter    edges removed by merges
+``dynamic.refresh.runs``              counter    ``engine.refresh``
+                                                 calls; labels ``kind``
+                                                 (bfs / cc / pagerank),
+                                                 ``mode`` (cached /
+                                                 warm / cold)
+``dynamic.refresh.iters``             histogram  sweeps/iterations one
+                                                 refresh ran (labels
+                                                 ``kind``, ``mode`` —
+                                                 warm-restart savings)
+``dynamic.refresh.latency_s``         histogram  refresh wall time
+                                                 (labels ``kind``,
+                                                 ``mode``)
+``serve.update.submitted``            counter    ``submit_update``
+                                                 admissions
+``serve.update.rejected``             counter    write-lane
+                                                 backpressure rejects
+                                                 (full delta buffer)
+``serve.update.invalid``              counter    malformed update
+                                                 batches (failed their
+                                                 own future)
+``serve.update.merges``               counter    merge+swap cycles run
+                                                 by the mutation
+                                                 thread; labels
+                                                 ``mode``
+``serve.update.failed``               counter    merge cycles that
+                                                 failed (their updates'
+                                                 futures carry the
+                                                 error); labels
+                                                 ``exc_type``
+``serve.update.coalesced``            histogram  ops per merged batch
+                                                 (write coalescing)
+``tuner.store.compacted``             counter    superseded/evicted
+                                                 JSONL lines removed by
+                                                 the load-time
+                                                 compaction rewrite
+``tuner.store.evicted``               counter    plans dropped by the
+                                                 max-entries
+                                                 oldest-cost eviction
+====================================  =========  =======================
 """
 
 from __future__ import annotations

@@ -227,6 +227,47 @@ class EllParMat:
         assert axis == "cols", "EllParMat.reduce supports axis='cols' only"
         return _ell_reduce_rows_jit(self, sr, map_fn)
 
+    def to_host_coo(self):
+        """Read the buckets back and reconstruct the global COO, sorted
+        by (row, col): ``(rows, cols, vals)`` numpy arrays.  Canonical —
+        independent of bucket layout, slot order, or which class a
+        sticky incremental merge left a row in — so two EllParMats with
+        equal content compare bit-exact (the dynamic-merge acceptance
+        check).  A D2H readback: test/tooling path only, never ahead of
+        timed launches on readback-poisoned chips (bench.py)."""
+        import jax
+
+        lr, lc = self.local_rows, self.local_cols
+        rows_all, cols_all, vals_all = [], [], []
+        for bc, bv, br in self.buckets:
+            bc = np.asarray(jax.device_get(bc))
+            bv = np.asarray(jax.device_get(bv))
+            br = np.asarray(jax.device_get(br))
+            pr_, pc_ = bc.shape[0], bc.shape[1]
+            valid = (bc < lc) & (br[..., None] < lr)
+            gr = np.broadcast_to(
+                (np.arange(pr_, dtype=np.int64)[:, None, None] * lr
+                 + br)[..., None],
+                bc.shape,
+            )
+            gc = (
+                np.arange(pc_, dtype=np.int64)[None, :, None, None] * lc
+                + bc
+            )
+            rows_all.append(gr[valid])
+            cols_all.append(gc[valid])
+            vals_all.append(bv[valid])
+        if not rows_all:
+            return (
+                np.empty(0, np.int64), np.empty(0, np.int64),
+                np.empty(0, np.float32),
+            )
+        r = np.concatenate(rows_all)
+        c = np.concatenate(cols_all)
+        v = np.concatenate(vals_all)
+        order = np.argsort(r * np.int64(self.ncols) + c, kind="stable")
+        return r[order], c[order], v[order]
+
 
 def _width_ladder(max_k: int, kind: str = "fine") -> "np.ndarray":
     """Bucket widths clamped to include max_k.

@@ -19,8 +19,11 @@ batches". Four layers (docs/serving.md has the full architecture):
 4. **api** (`api.py`) — ``Server``: ``submit()/submit_many()/stats()``
    plus the single worker thread that owns the execution stream, the
    poisoned-batch bisection retrier, execution-time deadline
-   enforcement, ``health()``, and ``swap_graph()`` (atomic graph-
-   version hot-swap, plan cache surviving).
+   enforcement, ``health()``, ``swap_graph()`` (atomic graph-version
+   hot-swap, plan cache surviving), and the WRITE lane —
+   ``submit_update()`` + a mutation thread coalescing edge deltas into
+   incremental merges (``combblas_tpu.dynamic``, docs/dynamic.md)
+   off the execution lock, reads staying hot throughout.
 5. **faults** (`faults.py`) — deterministic fault injection: named
    failure points threaded through the worker path, armed with
    scripted/seeded/predicate rules so every recovery path (bisection,

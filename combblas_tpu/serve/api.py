@@ -34,6 +34,7 @@ import sys
 import threading
 import time
 import traceback
+from collections import deque
 from concurrent.futures import Future
 
 from .. import obs
@@ -72,6 +73,23 @@ class Server:
         self._timeout_exec: dict[str, int] = {}
         self._poisoned: dict[str, int] = {}
         self._retried: dict[str, int] = {}
+        # -- write lane (docs/dynamic.md): the delta buffer, its
+        # dedicated mutation thread, and the futures awaiting a merge.
+        # _merge_mutex serializes whole merge cycles (drain -> apply ->
+        # swap) so a pump_updates() call can never interleave with the
+        # mutator and apply a batch against a stale parent version.
+        self._upd_cond = threading.Condition()
+        self._upd_buffer = None  # lazy dynamic.DeltaBuffer
+        self._upd_futs: deque = deque()  # (last_seq, Future)
+        self._upd_stop = False
+        self._mutator: threading.Thread | None = None
+        self._merge_mutex = threading.Lock()
+        self.updates_submitted = 0
+        self.update_merges = 0
+        self.update_failures = 0
+        self.updates_invalid = 0
+        self._merge_modes: dict[str, int] = {}
+        self._merge_s: dict[str, float] = {}
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -128,6 +146,10 @@ class Server:
             self.scheduler.fail_pending(
                 RuntimeError("serve.Server closed without drain")
             )
+        # the write lane stops LAST: its final merges may swap the
+        # graph, and the read drain above must run on one consistent
+        # execution stream either way (the engine lock serializes)
+        self._stop_mutator(drain, timeout)
 
     def __enter__(self) -> "Server":
         return self.start()
@@ -176,6 +198,214 @@ class Server:
         with self._wake:
             self._wake.notify_all()
         return out
+
+    # -- write lane (the mutation lane; docs/dynamic.md) -------------------
+
+    def _make_update_buffer(self):
+        from ..dynamic import DeltaBuffer
+
+        return DeltaBuffer(
+            capacity=self.config.update_buffer,
+            nrows=self.engine.nrows,
+            ncols=int(self.engine.version.ncols),
+            retry_after_s=self.config.update_max_delay_s,
+        )
+
+    def submit_update(self, ops) -> Future:
+        """Admit a batch of edge mutations — ``ops`` is a sequence of
+        ``("insert" | "delete" | "upsert", row, col[, weight])`` tuples
+        admitted ATOMICALLY into the bounded delta buffer.  Returns a
+        Future that resolves (``{"version", "nnz", "mode", "ops",
+        "merge_s"}``) once the merge CONTAINING these ops has been
+        applied and atomically swapped in; reads submitted after that
+        point see the mutated graph.
+
+        Mirrors the read lane's contracts: a full buffer raises
+        ``BackpressureError`` (reject + retry-after, never unbounded
+        buffering), malformed ops come back as failed futures (error
+        isolation — lane-mates in the same call are rejected with
+        them, since admission is atomic), and a closed server raises.
+        Writes COALESCE: the merge runs off the execution lock on the
+        mutation thread while reads keep executing; only the version
+        swap itself takes the lock."""
+        from ..dynamic import DeltaOverflowError
+
+        if self.scheduler.closed:
+            raise RuntimeError(
+                "serve.Server is closed; no further admissions"
+            )
+        if self.engine.version.host_coo is None:
+            raise ValueError(
+                "the mutation lane needs the host edge list: build "
+                "the engine with GraphEngine.from_coo(keep_coo=True)"
+            )
+        ops = list(ops)
+        self.faults.check("update.submit", nops=len(ops))
+        fut: Future = Future()
+        with self._upd_cond:
+            if self._upd_buffer is None:
+                self._upd_buffer = self._make_update_buffer()
+            try:
+                last = self._upd_buffer.add_many(ops)
+            except DeltaOverflowError as e:
+                raise BackpressureError(
+                    self._upd_buffer.depth(), e.retry_after_s
+                ) from e
+            except ValueError as e:
+                # malformed op: fail THIS future, poison nothing
+                self.updates_invalid += 1
+                obs.count("serve.update.invalid")
+                fut.set_exception(e)
+                return fut
+            self._upd_futs.append((last, fut))
+            self.updates_submitted += 1
+            obs.count("serve.update.submitted")
+            if self.config.update_autostart:
+                self._ensure_mutator()
+            self._upd_cond.notify_all()
+        return fut
+
+    def _ensure_mutator(self) -> None:
+        # called under _upd_cond
+        if self._mutator is None or not self._mutator.is_alive():
+            self._upd_stop = False
+            self._mutator = threading.Thread(
+                target=self._mutate_loop, name="combblas-serve-mutate",
+                daemon=True,
+            )
+            self._mutator.start()
+
+    def _updates_due(self, now: float) -> bool:
+        b = self._upd_buffer
+        if b is None:
+            return False
+        d = b.depth()
+        if d == 0:
+            return False
+        if d >= self.config.update_flush:
+            return True
+        age = b.oldest_age(now)
+        return age is not None and age >= self.config.update_max_delay_s
+
+    def pump_updates(self, force: bool = False) -> int:
+        """One synchronous write-lane step (the mutation thread's body,
+        callable directly for deterministic tests / worker-less
+        embedding): merge + swap the pending delta batch if one is due
+        (or unconditionally under ``force``).  Returns ops merged."""
+        if not force and not self._updates_due(time.monotonic()):
+            return 0
+        return self._merge_once()
+
+    def _merge_once(self) -> int:
+        """Drain -> apply_delta -> swap -> settle one batch's futures.
+        Serialized on ``_merge_mutex`` so concurrent callers can never
+        apply a batch against a stale parent version (which would
+        silently drop the other batch's mutations)."""
+        with self._merge_mutex:
+            with self._upd_cond:
+                b = self._upd_buffer
+                batch = b.drain() if b is not None else None
+                futs = []
+                if batch is not None:
+                    while (
+                        self._upd_futs
+                        and self._upd_futs[0][0] <= batch.last_seq
+                    ):
+                        futs.append(self._upd_futs.popleft()[1])
+            if batch is None:
+                return 0
+            try:
+                self.faults.check("update.merge", nops=len(batch))
+                version = self.engine.apply_delta(batch)
+                res = self.swap_graph(version)
+                st = version.dyn.last_stats
+                self.update_merges += 1
+                self._merge_modes[st.mode] = (
+                    self._merge_modes.get(st.mode, 0) + 1
+                )
+                self._merge_s[st.mode] = (
+                    self._merge_s.get(st.mode, 0.0) + st.latency_s
+                )
+                obs.count("serve.update.merges", mode=st.mode)
+                obs.observe("serve.update.coalesced", len(batch))
+                payload = {
+                    "version": res["version"],
+                    "nnz": res["nnz"],
+                    "mode": st.mode,
+                    "ops": len(batch),
+                    "merge_s": st.latency_s,
+                }
+                for f in futs:
+                    batcher.settle(f, result=payload)
+            except Exception as e:  # failure touches THIS batch only:
+                # the old version keeps serving, later merges proceed
+                self.update_failures += 1
+                obs.count(
+                    "serve.update.failed", exc_type=type(e).__name__
+                )
+                for f in futs:
+                    batcher.settle(f, exc=e)
+            return len(batch)
+
+    def _mutate_loop(self) -> None:
+        while True:
+            with self._upd_cond:
+                while not self._upd_stop and not self._updates_due(
+                    time.monotonic()
+                ):
+                    b = self._upd_buffer
+                    age = b.oldest_age() if b is not None else None
+                    self._upd_cond.wait(
+                        None if age is None else max(
+                            0.001,
+                            self.config.update_max_delay_s - age,
+                        )
+                    )
+                if self._upd_stop and (
+                    self._upd_buffer is None
+                    or self._upd_buffer.depth() == 0
+                ):
+                    break
+            # stopping with pending ops falls through: the final
+            # merge(s) run before the thread exits (close() drains)
+            self._merge_once()
+
+    def _stop_mutator(self, drain: bool, timeout: float) -> None:
+        futs: list = []
+        with self._upd_cond:
+            self._upd_stop = True
+            if not drain:
+                # abort BEFORE waking the mutator: its stop path merges
+                # whatever is still buffered, and a no-drain close must
+                # abandon those writes (matching the read lane), not
+                # apply-and-swap them behind the caller's back.  An
+                # IN-FLIGHT merge already popped its futures, so what
+                # remains here maps exactly to the drained-away ops.
+                b = self._upd_buffer
+                if b is not None:
+                    b.drain()
+                futs = [f for _s, f in self._upd_futs]
+                self._upd_futs.clear()
+            self._upd_cond.notify_all()
+        if not drain:
+            exc = RuntimeError("serve.Server closed without drain")
+            for f in futs:
+                batcher.settle(f, exc=exc)
+        if self._mutator is not None:
+            self._mutator.join(timeout)
+            if self._mutator.is_alive():
+                raise TimeoutError(
+                    f"serve mutation thread did not stop within "
+                    f"{timeout}s"
+                )
+            self._mutator = None
+        # a never-started mutator (update_autostart=False) may still
+        # hold pending ops on a draining close: merge them here
+        if drain and (
+            self._upd_buffer is not None and self._upd_buffer.depth()
+        ):
+            while self._merge_once():
+                pass
 
     # -- worker ------------------------------------------------------------
 
@@ -432,9 +662,35 @@ class Server:
             ),
             lane_widths=list(self.config.lane_widths),
             max_queue=self.config.max_queue,
+            updates=self._update_stats(),
         )
         obs.gauge("serve.batches", self.batches)
         return s
+
+    def _update_stats(self) -> dict:
+        """Write-lane disposition: merge counts/mode split (the
+        rebuild-amortization surface the mutate bench gates on)."""
+        with self._upd_cond:
+            pending = (
+                self._upd_buffer.depth()
+                if self._upd_buffer is not None else 0
+            )
+            buf = (
+                self._upd_buffer.stats()
+                if self._upd_buffer is not None else None
+            )
+        return {
+            "submitted": self.updates_submitted,
+            "invalid": self.updates_invalid,
+            "merges": self.update_merges,
+            "failed": self.update_failures,
+            "pending": pending,
+            "by_mode": dict(self._merge_modes),
+            "merge_s_by_mode": {
+                k: round(v, 6) for k, v in self._merge_s.items()
+            },
+            "buffer": buf,
+        }
 
     def health(self) -> dict:
         """Liveness/readiness introspection, cheap enough to poll: the
@@ -474,4 +730,11 @@ class Server:
             "breakers": breakers,
             "graph_version": self.engine.version_id,
             "swaps": self.engine.swaps,
+            "updates_pending": (
+                self._upd_buffer.depth()
+                if self._upd_buffer is not None else 0
+            ),
+            "mutator_alive": (
+                self._mutator is not None and self._mutator.is_alive()
+            ),
         }

@@ -88,6 +88,11 @@ class GraphVersion:
     csc: object = None             # lazy CSC companion cache
     coldeg: object = None          # lazy col-degree DistVec cache
     host_coo: tuple | None = None  # retained iff keep_coo=True
+    host_weights: object = None    # deduped weights (the mutation lane)
+    dyn: object = None             # dynamic.merge.MergeState (host
+    #                                bucket structure for apply_delta)
+    delta_from: tuple | None = None  # (parent vid, inserted keys,
+    #                                removed keys) — refresh lineage
     vid: int = 0                   # assigned when installed/swapped in
 
 
@@ -166,6 +171,9 @@ def _build_version(grid, rows, cols, nrows: int, ncols: int,
         outdeg=outdeg, E_weighted=E_weighted, P_ell=P_ell,
         dangling=dangling, ET=ET,
         host_coo=(rows, cols, ncols) if keep_coo else None,
+        # the deduped (min-combined) weights ride along for the
+        # mutation lane's merge-state bootstrap
+        host_weights=weights if keep_coo else None,
     )
 
 
@@ -222,6 +230,9 @@ class GraphEngine:
         self.pagerank_opts = pagerank_opts
         self.max_iters = max_iters
         self._plans: dict[tuple[str, int], _Plan] = {}
+        # whole-graph analytics cache for refresh(): (kind, root) ->
+        # {vid, result, niter} — the warm-restart recompute's memory
+        self._analytics: dict = {}
         # ONE execution stream: plan building, warmup, and execute all
         # serialize here, so a caller-thread warmup() cannot race the
         # api worker's pump() on the plan cache (or the device)
@@ -367,6 +378,42 @@ class GraphEngine:
         )
         obs.observe("serve.swap.build_s", time.perf_counter() - t0)
         return v
+
+    def apply_delta(self, batch, **kw) -> GraphVersion:
+        """Build the NEXT version by merging a delta batch into the
+        CURRENT one (``combblas_tpu.dynamic.merge.apply_delta`` — per
+        tile, slot-capacity-aware, spill-to-rebuild; see
+        docs/dynamic.md).  Like ``build_version`` this runs entirely
+        OUTSIDE the execution lock and the current version keeps
+        serving; hand the result to ``swap()`` / ``Server.swap_graph``
+        — an incremental merge preserves every operand shape, so the
+        swap keeps the zero-retrace guarantee.  Requires the host edge
+        list (``from_coo(..., keep_coo=True)``)."""
+        from ..dynamic import merge as dyn_merge
+
+        t0 = time.perf_counter()
+        v = dyn_merge.apply_delta(
+            self._version, batch, kinds=self._kinds, **kw
+        )
+        obs.observe("serve.swap.build_s", time.perf_counter() - t0)
+        return v
+
+    def refresh(self, kind: str, root: int | None = None,
+                force_cold: bool = False) -> dict:
+        """Whole-graph analytic with warm-restart recompute
+        (``dynamic.refresh``): BFS levels from ``root``, CC labels, or
+        the global PageRank vector — repaired from the engine's cached
+        previous result when the current version's delta lineage allows
+        it (insert-only for bfs/cc; always for pagerank), recomputed
+        cold otherwise.  Returns ``{"result", "niter", "mode"
+        (cached/warm/cold), "vid", ...}`` with host numpy results.
+        Serialized on the execution lock like every device access."""
+        from ..dynamic.refresh import refresh_analytic
+
+        with self._exec_lock:
+            return refresh_analytic(
+                self, kind, root=root, force_cold=force_cold
+            )
 
     def swap(self, version: GraphVersion) -> float:
         """Atomically install ``version`` as the current graph. Blocks

@@ -16,7 +16,12 @@ Failure points (``FAULT_POINTS``):
 * ``batch.scatter``    — after execution, before results reach futures;
 * ``engine.swap``      — inside ``Server.swap_graph``, before the
   atomic pointer flip (a failed build/validate must leave the old
-  version serving).
+  version serving);
+* ``update.submit``    — inside ``Server.submit_update``'s admission
+  (the write lane's front door);
+* ``update.merge``     — in the mutation thread, before
+  ``engine.apply_delta`` runs (a failed merge must fail exactly the
+  updates it carried and leave the current version serving).
 
 Rules, all deterministic:
 
@@ -55,6 +60,8 @@ FAULT_POINTS = (
     "engine.execute",
     "batch.scatter",
     "engine.swap",
+    "update.submit",
+    "update.merge",
 )
 
 

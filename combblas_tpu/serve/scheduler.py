@@ -251,6 +251,19 @@ class ServeConfig:
     breaker_cooldown_max_s: float = 30.0
     worker_backoff_s: float = 0.05
     worker_backoff_max_s: float = 2.0
+    # -- write lane (docs/dynamic.md "Serving writes"): submit_update
+    # admits edge mutations into a bounded DeltaBuffer (capacity
+    # ``update_buffer``; full = reject with BackpressureError) and a
+    # dedicated mutation thread merges a batch when ``update_flush``
+    # ops have coalesced OR the oldest has waited ``update_max_delay_s``
+    # — reads stay hot on the current version during the whole merge,
+    # only the atomic swap takes the execution lock.
+    # ``update_autostart=False`` disables the thread (deterministic
+    # tests drive ``Server.pump_updates()`` instead).
+    update_buffer: int = 4096
+    update_flush: int = 64
+    update_max_delay_s: float = 0.05
+    update_autostart: bool = True
 
     def __post_init__(self):
         if (
@@ -274,6 +287,12 @@ class ServeConfig:
             raise ValueError(
                 "need 0 < worker_backoff_s <= worker_backoff_max_s"
             )
+        if self.update_buffer < 1 or self.update_flush < 1:
+            raise ValueError(
+                "update_buffer and update_flush must be >= 1"
+            )
+        if self.update_max_delay_s <= 0:
+            raise ValueError("update_max_delay_s must be > 0")
 
     def wait_for(self, kind: str) -> float:
         if self.per_kind_max_wait and kind in self.per_kind_max_wait:

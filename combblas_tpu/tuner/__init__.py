@@ -20,6 +20,7 @@ kernels); config and store are dependency-light.
 """
 
 from . import config  # noqa: F401
+from .resolve import resolve_tier  # noqa: F401
 from .store import (  # noqa: F401
     PlanKey,
     PlanRecord,
@@ -36,6 +37,7 @@ from .store import (  # noqa: F401
 
 __all__ = [
     "config",
+    "resolve_tier",
     "PlanKey",
     "PlanRecord",
     "PlanStore",

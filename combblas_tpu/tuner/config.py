@@ -51,12 +51,29 @@ ENV_PROBE = "COMBBLAS_TUNER_PROBE"          # "1" enables the probe pass
 ENV_PROBE_BUDGET = "COMBBLAS_TUNER_PROBE_BUDGET_S"
 ENV_PROBE_MAX_DIM = "COMBBLAS_TUNER_PROBE_MAX_DIM"
 
+#: Plan-store aging knobs (round 11): long-lived fleet stores grow one
+#: appended line per superseded plan and one per new serve lane; these
+#: bound the file and the loaded set.
+ENV_STORE_MAX = "COMBBLAS_PLAN_STORE_MAX"             # entries cap
+ENV_STORE_COMPACT = "COMBBLAS_PLAN_STORE_COMPACT_MIN"  # superseded-line
+#                                                     # rewrite trigger
+
+#: Dynamic-graph mutation knobs (round 11, docs/dynamic.md).
+ENV_DYNAMIC_SPILL = "COMBBLAS_DYNAMIC_SPILL_FRAC"
+
 #: Default probe budget: total measured seconds across all candidate
 #: rungs for ONE store miss (compiles excluded from the budget check
 #: only insofar as the first candidate always completes).
 DEFAULT_PROBE_BUDGET_S = 30.0
 #: Proxy dimension cap for the downsampled probe operands.
 DEFAULT_PROBE_MAX_DIM = 2048
+#: Plan-store entry cap (oldest-cost eviction past it) and the
+#: superseded-line count that triggers a load-time compaction rewrite.
+DEFAULT_STORE_MAX_ENTRIES = 4096
+DEFAULT_STORE_COMPACT_MIN = 32
+#: Structural-change fraction above which ``dynamic.apply_delta``
+#: spills to a full rebuild (the incremental path's amortization bound).
+DEFAULT_DYNAMIC_SPILL_FRAC = 0.10
 
 
 def _str_env(name: str) -> str | None:
@@ -152,3 +169,25 @@ def probe_budget_s() -> float:
 def probe_max_dim() -> int:
     v = os.environ.get(ENV_PROBE_MAX_DIM)
     return int(v) if v else DEFAULT_PROBE_MAX_DIM
+
+
+def store_max_entries() -> int:
+    """Plan-store entry cap: past it the loader evicts oldest-cost
+    entries (``tuner.store.evicted``).  ``0``/unset = the default."""
+    v = _int_env(ENV_STORE_MAX)
+    return DEFAULT_STORE_MAX_ENTRIES if v is None else v
+
+
+def store_compact_min() -> int:
+    """Superseded (last-wins-shadowed) line count that triggers the
+    load-time compaction rewrite (``tuner.store.compacted``)."""
+    v = _int_env(ENV_STORE_COMPACT)
+    return DEFAULT_STORE_COMPACT_MIN if v is None else v
+
+
+def dynamic_spill_frac() -> float:
+    """Structural-change fraction above which the incremental merge
+    spills to a full rebuild (``dynamic.merge.spill{reason=threshold}``).
+    """
+    v = os.environ.get(ENV_DYNAMIC_SPILL)
+    return float(v) if v else DEFAULT_DYNAMIC_SPILL_FRAC

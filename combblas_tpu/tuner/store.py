@@ -121,6 +121,10 @@ class PlanRecord:
     source: str = "probe"          # probe | manual | bench
     probe_dim: int | None = None   # proxy dimension the cost came from
     lanes: tuple = ()
+    #: Measurement wall-clock (``time.time()``): the aging policy's
+    #: eviction order — records without one age out first.  Excluded
+    #: from equality (bookkeeping, not part of the decision).
+    ts: float | None = dataclasses.field(default=None, compare=False)
 
     def to_json(self) -> dict:
         d = dataclasses.asdict(self)
@@ -158,6 +162,7 @@ class PlanRecord:
             lanes=tuple(
                 (str(k), int(w)) for k, w in d.get("lanes", ())
             ),
+            ts=None if d.get("ts") is None else float(d["ts"]),
         )
 
 
@@ -175,6 +180,8 @@ class PlanStore:
         self._invalid = 0
         self._probe_runs = 0
         self._probe_seconds = 0.0
+        self._compacted = 0
+        self._evicted = 0
         self._load()
         if obs.ENABLED:
             obs.gauge("tuner.store.entries", len(self._plans),
@@ -186,8 +193,14 @@ class PlanStore:
         try:
             with open(self.file, encoding="utf-8") as f:
                 lines = f.readlines()
+                # size snapshot of what we actually read: the
+                # compaction rewrite below refuses to replace a file
+                # another process has appended to since (fleet stores
+                # are shared; see _compact)
+                self._loaded_size = os.fstat(f.fileno()).st_size
         except OSError:
             return  # no store yet: every lookup is a miss
+        valid_lines = 0
         for line in lines:
             line = line.strip()
             if not line:
@@ -205,7 +218,79 @@ class PlanStore:
                 if obs.ENABLED:
                     obs.count("tuner.store.invalid")
                 continue
+            valid_lines += 1
             self._plans[key] = rec  # append-only log: later lines win
+        # -- aging (round 11): the append-only log grows one line per
+        # superseded plan / refreshed lane set; bound BOTH the loaded
+        # set (max-entries cap, oldest-cost eviction) and the file
+        # (compaction rewrite of last-wins shadowed lines)
+        superseded = valid_lines - len(self._plans)
+        evicted = self._evict_to_cap(config.store_max_entries())
+        if superseded + evicted >= max(config.store_compact_min(), 1):
+            self._compact(superseded + evicted)
+
+    def _evict_to_cap(self, cap: int, protect: "PlanKey | None" = None
+                      ) -> int:
+        """Drop OLDEST-COST entries (the ``ts`` stamped when the cost
+        was measured; records without one age out first, insertion
+        order breaking ties) until at most ``cap`` remain.  Load-time
+        and put-time callers; counted in ``tuner.store.evicted``.  One
+        sort, then prefix deletion — a per-eviction min-scan would be
+        O(n * evicted) exactly when a grossly over-cap fleet file is
+        what triggered the eviction."""
+        cap = max(cap, 1)
+        if len(self._plans) <= cap:
+            return 0
+        order = {k: i for i, k in enumerate(self._plans)}
+        victims = sorted(
+            (k for k in self._plans if k != protect),
+            key=lambda k: ((self._plans[k].ts or 0.0), order[k]),
+        )
+        n = 0
+        for k in victims:
+            if len(self._plans) <= cap:
+                break
+            del self._plans[k]
+            n += 1
+        if n:
+            self._evicted += n
+            if obs.ENABLED:
+                obs.count("tuner.store.evicted", n)
+        return n
+
+    def _compact(self, removed_lines: int) -> None:
+        """Rewrite ``plans.jsonl`` as exactly the surviving entries
+        (insertion order preserved), atomically — a crash mid-rewrite
+        leaves either the old or the new file, never a torn one.
+        Fleet stores are SHARED: if the file grew since we read it
+        (another process appended a plan), the rewrite is SKIPPED —
+        losing a sibling's fresh measurement to save a few stale lines
+        is the wrong trade, and the next loader compacts instead.  (A
+        write landing inside the final stat->replace window can still
+        be lost — the store self-heals by re-probing; full fencing
+        would need file locks this robustness contract avoids.)"""
+        tmp = self.file + ".tmp"
+        try:
+            if os.path.getsize(self.file) != getattr(
+                self, "_loaded_size", -1
+            ):
+                return  # concurrent appender: leave the log alone
+            os.makedirs(self.path, exist_ok=True)
+            with open(tmp, "w", encoding="utf-8") as f:
+                for key, rec in self._plans.items():
+                    f.write(json.dumps({
+                        "v": SCHEMA, "key": key.to_json(),
+                        "plan": rec.to_json(),
+                    }) + "\n")
+            os.replace(tmp, self.file)
+        except OSError:
+            # read-only replica: the in-memory view is compact anyway
+            if obs.ENABLED:
+                obs.count("tuner.store.write_errors")
+            return
+        self._compacted += removed_lines
+        if obs.ENABLED:
+            obs.count("tuner.store.compacted", removed_lines)
 
     def _append(self, key: PlanKey, rec: PlanRecord) -> None:
         line = json.dumps(
@@ -248,8 +333,15 @@ class PlanStore:
 
     def put(self, key: PlanKey, rec: PlanRecord,
             persist: bool = True) -> None:
+        import time
+
+        if rec.ts is None:
+            rec.ts = time.time()  # the aging policy's eviction order
         with self._lock:
             self._plans[key] = rec
+            # cap holds at put time too (the file keeps the evicted
+            # line until the next load-time compaction reclaims it)
+            self._evict_to_cap(config.store_max_entries(), protect=key)
         if persist:
             self._append(key, rec)
         if obs.ENABLED:
@@ -260,6 +352,8 @@ class PlanStore:
                        width: int) -> bool:
         """Merge one (kind, width) into the serve-lane record for
         ``key``; returns True (and persists) iff the lane is new."""
+        import time
+
         lane = (str(kind), int(width))
         with self._lock:
             rec = self._plans.get(key)
@@ -269,6 +363,8 @@ class PlanStore:
             if lane in rec.lanes:
                 return False
             rec.lanes = tuple(sorted(set(rec.lanes) | {lane}))
+            rec.ts = time.time()  # an actively-serving graph's lane
+            # set stays young under the aging policy
         self._append(key, rec)
         return True
 
@@ -298,6 +394,8 @@ class PlanStore:
                 "invalid_lines": self._invalid,
                 "probe_runs": self._probe_runs,
                 "probe_seconds": round(self._probe_seconds, 4),
+                "compacted_lines": self._compacted,
+                "evicted": self._evicted,
             }
 
 

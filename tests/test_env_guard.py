@@ -1,0 +1,83 @@
+"""Tier-1 guard: ``COMBBLAS_*`` env knobs are parsed in ONE place.
+
+Round 10 centralized every ``COMBBLAS_SPGEMM_*`` / tuner knob into
+``tuner/config.py`` (precedence documented once, identical "0 means
+default" semantics everywhere); round 11 added the dynamic-lane and
+store-aging knobs THROUGH that module.  This test locks the invariant
+in: any new ``os.environ`` read of a ``COMBBLAS_`` name outside the
+allowlist below fails tier-1, so scattered knob parsing cannot creep
+back.
+
+Allowed:
+
+* ``tuner/config.py`` — the one parser;
+* ``obs/__init__.py`` — ``COMBBLAS_OBS`` / ``COMBBLAS_OBS_SYNC`` only:
+  the telemetry gate must resolve at import time without pulling the
+  tuner package into every obs consumer.
+"""
+
+import os
+import re
+
+import combblas_tpu
+
+PKG_ROOT = os.path.dirname(os.path.abspath(combblas_tpu.__file__))
+
+#: file (relative, /-separated) -> allowed COMBBLAS_* names, or "*".
+ALLOWED = {
+    "tuner/config.py": "*",
+    "obs/__init__.py": {"COMBBLAS_OBS", "COMBBLAS_OBS_SYNC"},
+}
+
+_NAME = re.compile(r"COMBBLAS_[A-Z0-9_]+")
+
+
+def _env_read_names(lines, idx, window=2):
+    """COMBBLAS_* names within ``window`` lines of an os.environ read
+    (catches the name sitting on the call line or a continuation)."""
+    lo = max(0, idx - window)
+    hi = min(len(lines), idx + window + 1)
+    names = set()
+    for ln in lines[lo:hi]:
+        names.update(_NAME.findall(ln))
+    return names
+
+
+def test_no_stray_combblas_env_reads():
+    violations = []
+    for dirpath, _dirnames, filenames in os.walk(PKG_ROOT):
+        if "__pycache__" in dirpath:
+            continue
+        for fn in filenames:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, PKG_ROOT).replace(os.sep, "/")
+            allowed = ALLOWED.get(rel, set())
+            if allowed == "*":
+                continue
+            with open(path, encoding="utf-8") as f:
+                lines = f.readlines()
+            for i, line in enumerate(lines):
+                if "os.environ" not in line and "environ[" not in line:
+                    continue
+                stray = _env_read_names(lines, i) - set(allowed)
+                if stray:
+                    violations.append(
+                        f"{rel}:{i + 1}: {sorted(stray)}"
+                    )
+    assert not violations, (
+        "COMBBLAS_* env reads outside tuner/config.py (add an accessor "
+        "there instead — precedence and '0 means default' semantics "
+        "live in one place):\n" + "\n".join(violations)
+    )
+
+
+def test_dynamic_knobs_centralized():
+    """The round-11 knobs exist and parse through tuner/config."""
+    from combblas_tpu.tuner import config
+
+    assert config.ENV_DYNAMIC_SPILL.startswith("COMBBLAS_")
+    assert 0 < config.dynamic_spill_frac() <= 1.0
+    assert config.store_max_entries() >= 1
+    assert config.store_compact_min() >= 1

@@ -518,3 +518,69 @@ def test_obs_smoke_bench_trace_matches_schema(tmp_path):
     assert "compile_cache.misses" in agg["counters"]
     # BFS lru-cache gauges exported via the provider
     assert any(k.startswith("cache.bfs.") for k in agg["gauges"])
+
+
+def test_round11_dynamic_counters_gated(rng):
+    """ISSUE 9 satellite: the round-11 dynamic-mutation series — delta
+    depth/ops, merge mode/latency, refresh runs, serve update counters
+    — are emitted under obs and cost NOTHING when disabled."""
+    from combblas_tpu.dynamic import DeltaBatch, DeltaBuffer, apply_delta
+    from combblas_tpu.parallel.grid import Grid
+    from combblas_tpu.serve import GraphEngine, ServeConfig
+
+    n = 48
+    r = rng.integers(0, n, 200)
+    c = rng.integers(0, n, 200)
+    eng = GraphEngine.from_coo(
+        Grid.make(1, 1), np.concatenate([r, c]), np.concatenate([c, r]),
+        n, kinds=("bfs",), keep_coo=True,
+    )
+    present = set(
+        zip(eng.version.host_coo[0].tolist(),
+            eng.version.host_coo[1].tolist())
+    )
+    a, b = next(
+        (a, b) for a in range(n) for b in range(n)
+        if a != b and (a, b) not in present
+    )
+    ops = [("insert", a, b), ("insert", b, a)]
+
+    def exercise():
+        buf = DeltaBuffer(capacity=8, nrows=n, ncols=n)
+        buf.add_many(ops)
+        batch = buf.drain()
+        v = apply_delta(eng.version, batch, kinds=eng.kinds())
+        eng.refresh("bfs", root=int(r[0]))
+        srv = eng.serve(ServeConfig(
+            lane_widths=(1,), update_autostart=False,
+        ))
+        srv.submit_update([("delete", a, b), ("delete", b, a)])
+        srv.pump_updates(force=True)
+        srv.close()
+        return v
+
+    assert not obs.ENABLED
+    exercise()
+    assert obs.registry.empty()  # disabled: zero bookkeeping
+
+    obs.enable(install_hooks=False)
+    try:
+        obs.reset()
+        eng._analytics.clear()
+        exercise()
+        g = obs.registry.get_counter
+        assert g("dynamic.delta.ops", op="insert") == 2
+        assert g("dynamic.delta.batches") >= 1
+        assert g("dynamic.merge.applied", mode="incremental") >= 1
+        assert obs.registry.get_histogram(
+            "dynamic.merge.latency_s"
+        )["count"] >= 1
+        assert g("dynamic.refresh.runs", kind="bfs", mode="cold") == 1
+        assert g("serve.update.submitted") == 1
+        assert g("serve.update.merges", mode="incremental") >= 1
+        assert obs.registry.get_histogram(
+            "serve.update.coalesced"
+        )["count"] >= 1
+    finally:
+        obs.disable()
+        obs.reset()

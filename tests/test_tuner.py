@@ -643,3 +643,195 @@ def test_compile_cache_provider_covers_plan_store(tmp_path, monkeypatch):
     finally:
         obs.disable()
         obs.reset()
+
+
+# --- round 11: store aging (compaction + oldest-cost eviction) --------------
+
+
+def _key_i(i: int) -> PlanKey:
+    """Distinct keys (different shape buckets) for aging tests."""
+    return plan_key_from_counts(
+        "plus_times", 1 << (8 + i), 1 << (8 + i), 1 << (8 + i),
+        1 << (10 + i), 1 << (10 + i), "scatter", "1x1",
+        platform="cpu",
+    )
+
+
+def test_store_ts_stamped_and_roundtrips(tmp_path):
+    st = PlanStore(str(tmp_path))
+    rec = PlanRecord(tier="scan", cost_s=1.0)
+    assert rec.ts is None
+    st.put(_key(), rec)
+    assert rec.ts is not None  # put stamps the measurement time
+    got = PlanStore(str(tmp_path)).lookup(_key())
+    assert got.ts == rec.ts
+
+
+def test_store_compaction_rewrites_superseded_lines(
+    tmp_path, monkeypatch
+):
+    """Load-time compaction: a log full of last-wins-shadowed lines is
+    rewritten to one line per surviving key (atomic replace), counted
+    in stats and the ``tuner.store.compacted`` counter."""
+    monkeypatch.setenv(config.ENV_STORE_COMPACT, "5")
+    st = PlanStore(str(tmp_path))
+    for i in range(8):  # 7 superseded lines for one key
+        st.put(_key(), PlanRecord(tier="scan", cost_s=float(i + 1)))
+    st.put(_key_i(1), PlanRecord(tier="windowed", cost_s=0.5))
+    with open(st.file) as f:
+        assert len(f.readlines()) == 9
+    obs.enable(install_hooks=False)
+    try:
+        obs.reset()
+        st2 = PlanStore(str(tmp_path))
+        assert st2.entries() == 2
+        assert st2.stats()["compacted_lines"] == 7
+        assert obs.registry.get_counter("tuner.store.compacted") == 7
+        with open(st2.file) as f:
+            lines = f.readlines()
+        assert len(lines) == 2  # the rewritten file is compact
+        # survivors keep their latest records
+        assert st2.lookup(_key()).cost_s == 8.0
+        assert st2.lookup(_key_i(1)).tier == "windowed"
+        # a third load has nothing to compact
+        st3 = PlanStore(str(tmp_path))
+        assert st3.stats()["compacted_lines"] == 0
+    finally:
+        obs.disable()
+        obs.reset()
+
+
+def test_store_compaction_below_threshold_keeps_log(tmp_path,
+                                                    monkeypatch):
+    monkeypatch.setenv(config.ENV_STORE_COMPACT, "50")
+    st = PlanStore(str(tmp_path))
+    for i in range(4):
+        st.put(_key(), PlanRecord(tier="scan", cost_s=float(i + 1)))
+    st2 = PlanStore(str(tmp_path))
+    assert st2.stats()["compacted_lines"] == 0
+    with open(st2.file) as f:
+        assert len(f.readlines()) == 4  # append-only log untouched
+
+
+def test_store_max_entries_oldest_cost_eviction(tmp_path, monkeypatch):
+    """The cap evicts by measurement age: oldest ``ts`` first (records
+    without one age out before any stamped record), newest survive —
+    at load AND at put."""
+    monkeypatch.setenv(config.ENV_STORE_MAX, "3")
+    monkeypatch.setenv(config.ENV_STORE_COMPACT, "1")
+    st = PlanStore(str(tmp_path))
+    for i in range(5):
+        st.put(
+            _key_i(i),
+            PlanRecord(tier="scan", cost_s=1.0, ts=float(100 + i)),
+        )
+        assert st.entries() <= 3  # put-time cap holds throughout
+    assert st.stats()["evicted"] == 2
+    assert st.lookup(_key_i(0)) is None  # oldest ts evicted
+    assert st.lookup(_key_i(4)) is not None
+    # load-time: the file still carries all 5 lines until a reload
+    # compacts; the fresh instance loads, evicts to cap, and rewrites
+    st2 = PlanStore(str(tmp_path))
+    assert st2.entries() == 3
+    assert st2.lookup(_key_i(4)) is not None
+    with open(st2.file) as f:
+        assert len(f.readlines()) == 3
+
+
+def test_store_unstamped_records_age_out_first(tmp_path, monkeypatch):
+    monkeypatch.setenv(config.ENV_STORE_MAX, "2")
+    st = PlanStore(str(tmp_path))
+    st.put(_key_i(0), PlanRecord(tier="scan", ts=50.0))
+    unstamped = PlanRecord(tier="scan")
+    unstamped.ts = None  # simulate a pre-round-11 line
+    with st._lock:
+        st._plans[_key_i(1)] = unstamped
+    st.put(_key_i(2), PlanRecord(tier="scan", ts=60.0))
+    assert st.lookup(_key_i(1)) is None  # no ts = oldest
+    assert st.lookup(_key_i(0)) is not None
+
+
+# --- round 11: the shared resolve_tier helper -------------------------------
+
+
+def test_resolve_tier_precedence_and_vetting(tmp_path, monkeypatch):
+    """arg > store > env > heuristic, with the library's record
+    vetting: a key-matched record outside ``allowed`` is discarded
+    (``tuner.store.rejected{reason=tier}``) and resolution degrades."""
+    from combblas_tpu.tuner.resolve import resolve_tier
+
+    st = _use_store(monkeypatch, tmp_path)
+    key = _key()
+    obs.enable(install_hooks=False)
+    try:
+        obs.reset()
+        # heuristic rung (empty store, no env)
+        tier, src, rec = resolve_tier(
+            key, op="spgemm", allowed=("scan", "esc"),
+            heuristic=lambda: "esc", store=st,
+        )
+        assert (tier, src, rec) == ("esc", "heuristic", None)
+        # store rung
+        st.put(key, PlanRecord(tier="scan", cost_s=0.5))
+        tier, src, rec = resolve_tier(
+            key, op="spgemm", allowed=("scan", "esc"),
+            heuristic="esc", store=st,
+        )
+        assert (tier, src) == ("scan", "store") and rec.tier == "scan"
+        # vetting: same record under an op that doesn't allow the tier
+        tier, src, rec = resolve_tier(
+            key, op="spgemm3d", allowed=("esc", "windowed"),
+            heuristic="esc", store=st,
+        )
+        assert (tier, src, rec) == ("esc", "heuristic", None)
+        assert obs.registry.get_counter(
+            "tuner.store.rejected", reason="tier"
+        ) == 1
+        # env rung beats the heuristic when the record was rejected
+        monkeypatch.setenv(config.ENV_TIER3D, "windowed")
+        tier, src, _rec = resolve_tier(
+            key, op="spgemm3d", allowed=("esc", "windowed"),
+            heuristic="esc", store=st,
+        )
+        assert (tier, src) == ("windowed", "env")
+        # arg wins over everything
+        tier, src, _rec = resolve_tier(
+            key, op="spgemm", allowed=("scan", "esc"),
+            heuristic="esc", tier="mxu", store=st,
+        )
+        assert (tier, src) == ("mxu", "arg")
+        assert obs.registry.get_counter(
+            "spgemm.auto.plan_source", source="arg", tier="mxu",
+            op="spgemm",
+        ) == 1
+    finally:
+        obs.disable()
+        obs.reset()
+
+
+def test_resolve_tier_account_false_peeks_silently(tmp_path,
+                                                   monkeypatch):
+    """account=False (the spgemm3d_bench mirror): peek — no hit/miss
+    accounting, no plan_source counter."""
+    from combblas_tpu.tuner.resolve import resolve_tier
+
+    st = _use_store(monkeypatch, tmp_path)
+    key = _key(op="spgemm3d")
+    st.put(key, PlanRecord(tier="windowed", cost_s=0.5))
+    hits_before = st.stats()["hits"]
+    obs.enable(install_hooks=False)
+    try:
+        obs.reset()
+        tier, src, _rec = resolve_tier(
+            key, op="spgemm3d", allowed=("esc", "windowed"),
+            heuristic="esc", store=st, account=False,
+        )
+        assert (tier, src) == ("windowed", "store")
+        assert st.stats()["hits"] == hits_before  # peek, not lookup
+        assert obs.registry.get_counter(
+            "spgemm.auto.plan_source", source="store",
+            tier="windowed", op="spgemm3d",
+        ) == 0
+    finally:
+        obs.disable()
+        obs.reset()
