@@ -823,14 +823,29 @@ def emit(runs, seq_runs, construction_s, k1_info, t_start, variance=None):
 def serve_bench_main():
     """BENCH_SERVE=1: the query-serving benchmark
     (benchmarks/serve_bench.py — batched lanes vs one-call-per-query on
-    the 8-virtual-device CPU mesh), run as a subprocess so its forced
-    CPU platform / virtual-device flags never touch this process's
-    backend. The child emits its serve-throughput telemetry as a JSONL
-    sidecar through the existing obs.enable_sidecar plumbing
-    (BENCH_OBS defaults ON for this path; the sidecar path rides the
-    JSON line as "obs_jsonl")."""
+    the 8-virtual-device CPU mesh). The child emits its
+    serve-throughput telemetry as a JSONL sidecar through the existing
+    obs.enable_sidecar plumbing (BENCH_OBS defaults ON for this path;
+    the sidecar path rides the JSON line as "obs_jsonl")."""
+    _virtual_mesh_bench_main(
+        "serve_bench.py", "serve_throughput",
+        rc_of=lambda out: out.get("value", 0),
+        extra_env={"BENCH_OBS": "1"},
+    )
+
+
+def _virtual_mesh_bench_main(script_name: str, metric: str, rc_of,
+                             extra_env: dict | None = None):
+    """Shared child-runner for the virtual-8-device-mesh benches
+    (serve_bench / spmm_bench): subprocess isolation so the forced CPU
+    platform / device-count flags never touch THIS process's backend,
+    the timeout fallback, and the JSON-tail guard (the official stream
+    must stay one valid JSON line even when the child crashes or
+    leaves stray stdout).  ``rc_of(out)`` maps the child's final dict
+    to the summary rc."""
     env = dict(os.environ)
-    env.setdefault("BENCH_OBS", "1")
+    for k, v in (extra_env or {}).items():
+        env.setdefault(k, v)
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = (
         env.get("XLA_FLAGS", "")
@@ -838,7 +853,7 @@ def serve_bench_main():
     )
     script = os.path.join(
         os.path.dirname(os.path.abspath(__file__)),
-        "benchmarks", "serve_bench.py",
+        "benchmarks", script_name,
     )
     try:
         r = subprocess.run(
@@ -848,30 +863,42 @@ def serve_bench_main():
         )
     except subprocess.TimeoutExpired as e:
         out = {
-            "metric": "serve_throughput", "value": 0.0,
-            "error": f"serve bench child timed out after {e.timeout}s",
+            "metric": metric, "value": 0.0,
+            "error": f"{script_name} child timed out after {e.timeout}s",
         }
         print(json.dumps(out), flush=True)
         emit_summary(out, rc=1)
         return
     lines = [l for l in r.stdout.strip().splitlines() if l.strip()]
-    # same guard as run_child: the official stream must stay one valid
-    # JSON line even when the child crashes or leaves stray stdout
     try:
         if r.returncode != 0 or not lines:
             raise json.JSONDecodeError("child failed", "", 0)
         out = json.loads(lines[-1])
     except json.JSONDecodeError:
         out = {
-            "metric": "serve_throughput", "value": 0.0,
+            "metric": metric, "value": 0.0,
             "error": (r.stderr or "no output")[-2000:],
         }
     print(json.dumps(out), flush=True)
-    emit_summary(out, rc=0 if out.get("value", 0) else 1)
+    emit_summary(out, rc=0 if rc_of(out) else 1)
+
+
+def spmm_bench_main():
+    """BENCH_SPMM=1: the batched-SpMM benchmark
+    (benchmarks/spmm_bench.py — fused k-hop sparse×dense vs
+    loop-over-columns batch SpMV, scipy golden, and the serve
+    "propagate" zero-retrace capture)."""
+    _virtual_mesh_bench_main(
+        "spmm_bench.py", "spmm_khop_speedup",
+        rc_of=lambda out: out.get("ok"),
+    )
 
 
 def main():
     t_start = time.perf_counter()
+    if os.environ.get("BENCH_SPMM") == "1":
+        spmm_bench_main()
+        return
     if os.environ.get("BENCH_SERVE") == "1":
         serve_bench_main()
         return

@@ -75,6 +75,8 @@ class MergeStats:
     value_changed: int = 0     # edges whose weight changed (structure kept)
     rows_patched: int = 0      # rows rewritten in place (all orientations)
     rows_rebucketed: int = 0   # rows that claimed a slot in a new class
+    headroom_used: int = 0     # free padding slots claimed by re-bucketing
+    #                            (the headroom reserve paying off)
     buckets_uploaded: int = 0  # device bucket classes re-uploaded
     buckets_reused: int = 0    # device bucket classes shared with parent
     latency_s: float = 0.0
@@ -120,18 +122,21 @@ class MergeState:
 # -- host structure builders -------------------------------------------------
 
 
-def _build_orientation(grid, rows, cols, nrows: int,
-                       ncols: int) -> _Orientation:
+def _build_orientation(grid, rows, cols, nrows: int, ncols: int,
+                       headroom: float | None = None) -> _Orientation:
     """Host bucket structure for one layout — the SAME deterministic
-    ``EllParMat.host_build`` the loaded matrices came from, so untouched
-    classes can be shared with the existing device arrays."""
+    ``EllParMat.host_build`` the loaded matrices came from (INCLUDING
+    the headroom over-allocation: mismatched slack would change bucket
+    shapes and forfeit untouched-class sharing), so untouched classes
+    can be shared with the existing device arrays."""
     from ..parallel.ellmat import EllParMat, _width_ladder
 
     lr, lc = grid.local_rows(nrows), grid.local_cols(ncols)
     max_k = max(int(lc), 1)
     ladder = _width_ladder(max_k, "fine")
     buckets = EllParMat.host_build(
-        grid, rows, cols, np.ones(len(rows), np.float32), nrows, ncols
+        grid, rows, cols, np.ones(len(rows), np.float32), nrows, ncols,
+        headroom=headroom,
     )
     keys = np.asarray(rows, np.int64) * np.int64(ncols) + np.asarray(
         cols, np.int64
@@ -162,9 +167,11 @@ def bootstrap_state(version, grid=None) -> MergeState:
     nrows = int(version.nrows)
     ncols = int(ncols)
     grid = version.E.grid if grid is None else grid
-    row_o = _build_orientation(grid, rows, cols, nrows, ncols)
+    hr = getattr(version, "headroom", None)
+    row_o = _build_orientation(grid, rows, cols, nrows, ncols,
+                               headroom=hr)
     t_o = (
-        _build_orientation(grid, cols, rows, ncols, nrows)
+        _build_orientation(grid, cols, rows, ncols, nrows, headroom=hr)
         if version.ET is not None else None
     )
     weights = getattr(version, "host_weights", None)
@@ -326,6 +333,10 @@ def _patch_orientation(orient: _Orientation, new_keys: np.ndarray,
                 off += take
                 remaining -= take
                 rebucketed = True
+                # every claimed free padding row is headroom paying
+                # off (build-time reserve or natural tile imbalance) —
+                # the counter the headroom= knob is sized against
+                stats.headroom_used += 1
             for (b, p, o0, take) in writes:
                 ensure_copy(b)
                 orient.bc[b][i, j, p, :take] = cols_local[o0:o0 + take]
@@ -399,9 +410,11 @@ def _full_build(grid, version, keys: np.ndarray,
     nrows, ncols = int(version.nrows), int(version.ncols)
     rows = (keys // np.int64(ncols)).astype(np.int64)
     cols = (keys % np.int64(ncols)).astype(np.int64)
-    row_o = _build_orientation(grid, rows, cols, nrows, ncols)
+    hr = getattr(version, "headroom", None)
+    row_o = _build_orientation(grid, rows, cols, nrows, ncols,
+                               headroom=hr)
     t_o = (
-        _build_orientation(grid, cols, rows, ncols, nrows)
+        _build_orientation(grid, cols, rows, ncols, nrows, headroom=hr)
         if version.ET is not None else None
     )
     state = MergeState(
@@ -444,6 +457,12 @@ def _full_build(grid, version, keys: np.ndarray,
         deg=state.deg, outdeg=state.outdeg, E_weighted=E_weighted,
         P_ell=P_ell, dangling=dangling, ET=ET,
         host_coo=(rows, cols, ncols),
+        # the feature table is edge-independent: the rebuilt version
+        # keeps serving the same device arrays (invdeg stays None —
+        # degrees changed, it lazily rebuilds)
+        X=getattr(version, "X", None),
+        feat_dim=int(getattr(version, "feat_dim", 0)),
+        headroom=getattr(version, "headroom", None),
     )
     new_version.host_weights = weights
     new_version.dyn = state
@@ -535,10 +554,15 @@ def apply_delta(version, batch: DeltaBatch, *,
         if new_w is not None:
             new_w = np.insert(new_w, ipos, fw[np.searchsorted(uniq, ins)])
 
-    # symmetry: a bc-serving symmetric engine must STAY symmetric (the
-    # same verification serve.engine._build_version performs)
+    # symmetry: a bc- or propagate-serving symmetric engine must STAY
+    # symmetric (the same verification serve.engine._build_version
+    # performs — both kinds reuse E as its own transpose when ET is
+    # absent, so an asymmetric delta would silently flip the edge
+    # direction every served result walks)
     require_sym = (
-        kinds is not None and "bc" in kinds and version.ET is None
+        kinds is not None
+        and ("bc" in kinds or "propagate" in kinds)
+        and version.ET is None
     )
     if require_sym and nrows == ncols:
         def _sym(k):
@@ -580,6 +604,7 @@ def apply_delta(version, batch: DeltaBatch, *,
         obs.observe("dynamic.merge.latency_s", stats.latency_s)
         obs.count("dynamic.merge.rows_patched", stats.rows_patched)
         obs.count("dynamic.merge.rows_rebucketed", stats.rows_rebucketed)
+        obs.count("dynamic.merge.headroom_used", stats.headroom_used)
         obs.count("dynamic.merge.edges_inserted", stats.inserted)
         obs.count("dynamic.merge.edges_removed", stats.removed)
         return v
@@ -721,6 +746,26 @@ def apply_delta(version, batch: DeltaBatch, *,
         deg=new_deg, outdeg=new_outdeg, E_weighted=E_weighted,
         P_ell=P_ell, dangling=dangling, ET=ET,
         host_coo=(rows, cols, ncols),
+        # BUGFIX (round 12): the lazy CSC companion is STRUCTURAL
+        # (indptr + row ids, no values) — a fold that touched no edges
+        # (no-op upsert batch, weight-only change) leaves it exactly
+        # valid, so carry it instead of resetting to a full
+        # rebuild-from-COO on next use.  Any structural change still
+        # resets (None -> lazily rebuilt).  coldeg rides the same
+        # argument: out-degrees are untouched when no edge moved.
+        csc=(version.csc if changed_struct == 0 else None),
+        coldeg=(version.coldeg if changed_struct == 0 else None),
+        X=getattr(version, "X", None),
+        feat_dim=int(getattr(version, "feat_dim", 0)),
+        # same argument as csc/coldeg: no edge moved -> degrees are
+        # bit-identical -> the cached 1/deg vector stays valid (a
+        # normalized propagate engine would otherwise rebuild+upload
+        # it under the execution lock on the next batch)
+        invdeg=(
+            getattr(version, "invdeg", None)
+            if changed_struct == 0 else None
+        ),
+        headroom=getattr(version, "headroom", None),
     )
     new_version.host_weights = new_w
     new_version.dyn = new_state

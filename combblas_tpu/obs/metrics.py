@@ -317,6 +317,65 @@ name                                  kind       meaning
                                                  max-entries
                                                  oldest-cost eviction
 ====================================  =========  =======================
+
+Batched-SpMM / propagate-lane series (round 12 — the MXU-resident
+SpMM kernel family, the ``"propagate"`` serve kind, headroom-aware
+bucket sizing and window-geometry probing; docs/spmm.md):
+
+====================================  =======  =========================
+name                                  kind     meaning
+====================================  =======  =========================
+``trace.spmm_ell``                    counter  TRACE-TIME: ELL SpMM
+                                               kernel (re)traces,
+                                               labels ``backend``
+                                               (mxu_gather / scatter)
+                                               and ``sr`` — the
+                                               retrace-visibility
+                                               convention of the
+                                               ``trace.*`` series
+``trace.summa_spmm``                  counter  SUMMA SpMM (re)traces,
+                                               labels ``ring``
+                                               (gathered vs carousel)
+                                               and ``backend``
+``trace.spmm_khop``                   counter  fused k-hop program
+                                               (re)traces, labels
+                                               ``hops`` / ``backend``
+                                               / ``normalize``
+``spmm.pipeline.stages_overlapped``   counter  TRACE-TIME: carousel
+                                               stages whose successor
+                                               panel rotation was
+                                               issued before their
+                                               contraction (p−1 per
+                                               compiled pipelined ring
+                                               program — the SpMM twin
+                                               of ``spgemm.pipeline.
+                                               stages_overlapped``)
+``serve.propagate.feature_dim``       gauge    TRUE feature width of
+                                               the loaded table (pad
+                                               stripped; the pow2 pad
+                                               width is the compiled
+                                               shape)
+``spgemm.auto.plan_source``           counter  gains ``op="spmm"``
+                                               rows: where each SpMM
+                                               backend resolution came
+                                               from (arg / store / env
+                                               / probe / heuristic)
+``dynamic.merge.headroom_used``       counter  free padding slots
+                                               claimed by re-bucketing
+                                               rows (the
+                                               ``from_coo(headroom=)``
+                                               reserve paying off
+                                               instead of a
+                                               ``bucket_full`` spill)
+``tuner.probe.geometry_runs``         counter  windowed block-geometry
+                                               candidates measured by
+                                               the probe's
+                                               window-geometry sweep
+                                               (the winner persists
+                                               with ``block_rows`` /
+                                               ``block_cols`` in its
+                                               plan record)
+====================================  =======  =========================
 """
 
 from __future__ import annotations

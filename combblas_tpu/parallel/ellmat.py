@@ -89,6 +89,7 @@ class EllParMat:
     def from_host_coo(
         grid: Grid, rows, cols, vals, nrows: int, ncols: int,
         max_k: int | None = None, ladder: str = "fine",
+        headroom: float | None = None,
     ) -> "EllParMat":
         """Build directly from host global COO — fully numpy + one upload
         (the only safe construction path for real-chip benchmarking; see
@@ -103,10 +104,17 @@ class EllParMat:
         power-of-two widths — FEWER bucket classes (fewer small gathers
         per sweep), measurably better for 1-lane payloads (single-vector
         SpMV) which cannot amortize the extra per-bucket sweeps.
+
+        ``headroom`` (default: env ``COMBBLAS_DYNAMIC_HEADROOM``, 0)
+        over-allocates every bucket class by that fraction of FREE
+        padding rows: a high-churn dynamic graph's growing rows then
+        re-bucket into the reserved slots (``dynamic.merge.
+        headroom_used``) instead of spilling the whole merge to a
+        rebuild (``dynamic.merge.spill{reason=bucket_full}``).
         """
         host = EllParMat.host_build(
             grid, rows, cols, vals, nrows, ncols, max_k=max_k,
-            ladder=ladder,
+            ladder=ladder, headroom=headroom,
         )
         return EllParMat.from_host_buckets(grid, host, nrows, ncols)
 
@@ -131,13 +139,18 @@ class EllParMat:
     def host_build(
         grid: Grid, rows, cols, vals, nrows: int, ncols: int,
         max_k: int | None = None, ladder: str = "fine",
+        headroom: float | None = None,
     ):
         """HOST-ONLY bucket construction (no device touch): returns a list
         of (bc, bv, br) numpy arrays — the serializable half of
         ``from_host_coo``, split out so a bench parent process can build
         once and ship the arrays to timing children via .npz without ever
-        attaching to the chip itself."""
+        attaching to the chip itself.  ``headroom`` reserves extra free
+        padding rows per class (see ``from_host_coo``)."""
+        from ..tuner import config as tuner_config
         from .spmat import bucket_by_tile
+
+        headroom = tuner_config.dynamic_headroom(headroom)
 
         vals = np.asarray(vals)
         rows, cols, order, counts, starts, _cap, lr, lc = bucket_by_tile(
@@ -186,6 +199,12 @@ class EllParMat:
             kb = int(ladder[b])
             nb = max(int((pt[0] == b).sum()) for pt in per_tile)
             nb = max(nb, 1)
+            if headroom > 0:
+                # reserved re-bucketing slack: every tile of this class
+                # gets at least ceil(nb * headroom) FREE rows (padding
+                # rowid = lr, inert for the kernels) on top of the
+                # occupancy max — the dynamic merge's free-slot pool
+                nb += int(np.ceil(nb * headroom))
             bc = np.full((pr_, pc_, nb, kb), lc, np.int32)
             bv = np.zeros((pr_, pc_, nb, kb), vals.dtype)
             br = np.full((pr_, pc_, nb), lr, np.int32)
@@ -426,6 +445,55 @@ def _ell_reduce_rows_jit(E: EllParMat, sr: Semiring, map_fn) -> DistVec:
 # --- multi-root (batched) SpMV — frontier-as-matrix, SURVEY §2.3 #7 ---------
 
 
+def _ell_local_spmm(
+    sr: Semiring, buckets, x2: Array, lr: int, lc: int, backend: str
+) -> Array:
+    """[lr, F] semiring fold of one tile's buckets over a [lc, F]
+    dense block — the ONE local gather-contract kernel shared by the
+    batched SpMV lanes (W frontier columns) and the round-12 SpMM lane
+    (F feature columns).
+
+    Per bucket, ONE gather fetches each neighbor's whole payload row
+    (``[rows, kb, F]`` — per-index bound on the target chip, so the
+    width rides ~free), then the k axis contracts: backend
+    ``"mxu_gather"`` (plus_times only) via a batched ``dot_general``
+    ([1, kb] × [kb, F] per bucket row, MXU-eligible); backend
+    ``"scatter"`` via the VPU ``_bucket_fold`` + the duplicate-safe
+    ``_scatter_rows`` combine (every semiring).  Row slicing keeps the
+    gather intermediate under the same byte envelope as the batched
+    BFS step (``_bucket_row_slices``; the budget argument is BYTES per
+    slot — F lanes × itemsize here where the int8 BFS step passed W).
+    """
+    F = x2.shape[1]
+    zero = sr.zero(x2.dtype)
+    xpad = jnp.concatenate([x2, jnp.full((1, F), zero, x2.dtype)])
+    y = None
+    for bc, bv, br in buckets:
+        nb_, kb = bc.shape
+        payload = F * max(jnp.dtype(x2.dtype).itemsize, 1)
+        for s0, s1 in _bucket_row_slices(nb_, kb, payload):
+            g = xpad[jnp.minimum(bc[s0:s1], lc)]  # [rows, kb, F]
+            if backend == "mxu_gather":
+                # pad slots: bv holds 0 there (host_build zero-fills),
+                # so the plus_times contraction drops them exactly
+                out_dtype = jnp.result_type(bv.dtype, x2.dtype)
+                yb = lax.dot_general(
+                    bv[s0:s1][:, None, :].astype(out_dtype),
+                    g.astype(out_dtype),
+                    dimension_numbers=(((2,), (1,)), ((0,), (0,))),
+                    preferred_element_type=out_dtype,
+                )[:, 0, :]
+            else:
+                prods = sr.mul(bv[s0:s1][..., None], g)
+                yb = _bucket_fold(sr, prods)  # [rows, F]
+            if y is None:
+                y = jnp.full((lr, F), sr.zero(yb.dtype), yb.dtype)
+            y = _scatter_rows(sr, y, br[s0:s1], yb.astype(y.dtype))
+    if y is None:
+        y = jnp.full((lr, F), zero, x2.dtype)
+    return y
+
+
 def _ell_local_spmv_multi(sr: Semiring, buckets, x2: Array, lr, lc) -> Array:
     """[lr, W] semiring row fold over a [lc, W] input block.
 
@@ -433,23 +501,11 @@ def _ell_local_spmv_multi(sr: Semiring, buckets, x2: Array, lr, lc) -> Array:
     one gathered index fetches W lanes (measured on v5e: W=8 costs the same
     wall time as W=1 — the gather is per-index bound, so the batch rides
     free; this is the kernel-side payoff of multi-source BFS batching).
+    Since round 12 this IS the shared gather-contract kernel's scatter
+    backend — which also bounds hub-bucket gather intermediates with the
+    byte-envelope row slicing the int8 BFS step already had.
     """
-    W = x2.shape[1]
-    zero = sr.zero(x2.dtype)
-    xpad = jnp.concatenate([x2, jnp.full((1, W), zero, x2.dtype)])
-    y = None
-    out_dtype = None
-    for bc, bv, br in buckets:
-        g = xpad[jnp.minimum(bc, lc)]  # [nb, kb, W]
-        prods = sr.mul(bv[..., None], g)
-        yb = _bucket_fold(sr, prods)  # [nb, W]
-        if y is None:
-            out_dtype = yb.dtype
-            y = jnp.full((lr, W), sr.zero(out_dtype), out_dtype)
-        y = _scatter_rows(sr, y, br, yb.astype(out_dtype))
-    if y is None:
-        y = jnp.full((lr, W), zero, x2.dtype)
-    return y
+    return _ell_local_spmm(sr, buckets, x2, lr, lc, "scatter")
 
 
 @partial(jax.jit, static_argnames=("sr",))

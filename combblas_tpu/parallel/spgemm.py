@@ -164,6 +164,37 @@ def _carousel_stages(a_mine: SpTuples, b_mine: SpTuples, p: int):
             a_cur, b_cur = a_nxt, b_nxt
 
 
+def _carousel_stages_pair(a_mine: SpTuples, x_mine, p: int, *,
+                          pipeline: bool = True, dep=None):
+    """Carousel schedule for a (sparse tile, DENSE panel) operand pair
+    — the SpMM twin of ``_carousel_stages``: A rides ``_rotate_tiles``,
+    the dense feature panel rides a plain joint-axis ``ppermute``.
+    ``pipeline=True`` issues the rotation producing stage ``s+1``'s
+    operands BEFORE stage ``s``'s are consumed (two-slot buffers, the
+    r9 overlap schedule).  ``pipeline=False`` is the serial control:
+    the next rotation is PINNED behind the caller's accumulate via
+    ``dep`` (a zero-arg callable returning a stage-output array,
+    evaluated after the caller's loop body ran — the generator resumes
+    only on the next iteration request)."""
+    skew_a, skew_b, rot_a, rot_b = _carousel_perms(p)
+    a_cur = _rotate_tiles(a_mine, skew_a)
+    x_cur = lax.ppermute(x_mine, (ROW_AXIS, COL_AXIS), skew_b)
+    for s in range(p):
+        a_nxt = x_nxt = None
+        if pipeline and s != p - 1:
+            a_nxt = _rotate_tiles(a_cur, rot_a)
+            x_nxt = lax.ppermute(x_cur, (ROW_AXIS, COL_AXIS), rot_b)
+        yield s, a_cur, x_cur
+        if s != p - 1:
+            if not pipeline:
+                d = dep() if dep is not None else a_cur.nnz
+                a_pin = _chain_tiles(a_cur, d)
+                x_pin, _ = lax.optimization_barrier((x_cur, d))
+                a_nxt = _rotate_tiles(a_pin, rot_a)
+                x_nxt = lax.ppermute(x_pin, (ROW_AXIS, COL_AXIS), rot_b)
+            a_cur, x_cur = a_nxt, x_nxt
+
+
 @partial(
     jax.jit,
     static_argnames=("sr", "flop_capacity", "out_capacity", "ring"),

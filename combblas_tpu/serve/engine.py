@@ -45,8 +45,12 @@ import numpy as np
 from .. import obs
 from ..models import PAD_ROOT
 
-#: Query kinds the engine can build plans for.
-KINDS = ("bfs", "sssp", "pagerank", "bc")
+#: Query kinds the engine can build plans for.  ``"propagate"`` (round
+#: 12) is the graph-ML lane: lane w of a batch answers "the k-hop
+#: propagated feature row of vertex w" via the batched SpMM kernels
+#: (models/propagate.py) — it needs a feature table
+#: (``from_coo(features=...)``).
+KINDS = ("bfs", "sssp", "pagerank", "bc", "propagate")
 
 
 @dataclasses.dataclass
@@ -89,6 +93,15 @@ class GraphVersion:
     coldeg: object = None          # lazy col-degree DistVec cache
     host_coo: tuple | None = None  # retained iff keep_coo=True
     host_weights: object = None    # deduped weights (the mutation lane)
+    X: object = None               # propagate feature table (row-aligned
+    #                                DistMultiVec, pow2-padded F)
+    feat_dim: int = 0              # TRUE feature width (pad stripped)
+    invdeg: object = None          # lazy col-aligned 1/deg DistVec (the
+    #                                normalized-propagation twin; reset
+    #                                on merge — degrees changed)
+    headroom: float | None = None  # bucket-slot slack this version's
+    #                                ELL builds reserved (merge state
+    #                                must re-bucket with the same value)
     dyn: object = None             # dynamic.merge.MergeState (host
     #                                bucket structure for apply_delta)
     delta_from: tuple | None = None  # (parent vid, inserted keys,
@@ -98,7 +111,8 @@ class GraphVersion:
 
 def _build_version(grid, rows, cols, nrows: int, ncols: int,
                    weights, kinds: tuple[str, ...], symmetric: bool,
-                   keep_coo: bool) -> GraphVersion:
+                   keep_coo: bool, features=None,
+                   headroom: float | None = None) -> GraphVersion:
     """Host-side construction of every artifact ``kinds`` need (the
     body of the old ``from_coo``): dedup the COO, build the structural
     / weighted / normalized / transposed matrices and the degree
@@ -108,6 +122,14 @@ def _build_version(grid, rows, cols, nrows: int, ncols: int,
     from ..parallel.ellmat import EllParMat
     from ..parallel.vec import DistVec
 
+    from ..tuner import config as tuner_config
+
+    # resolve the env default NOW and store the concrete value: the
+    # merge state must re-bucket with the slack the build ACTUALLY
+    # used, not whatever COMBBLAS_DYNAMIC_HEADROOM says at merge time
+    # (a changed env between build and merge would silently desync
+    # orientation shapes from the retained device arrays)
+    headroom = tuner_config.dynamic_headroom(headroom)
     rows = np.asarray(rows)
     cols = np.asarray(cols)
     n = int(nrows)
@@ -120,11 +142,20 @@ def _build_version(grid, rows, cols, nrows: int, ncols: int,
         weights = w
     rows = (uniq // ncols).astype(rows.dtype)
     cols = (uniq % ncols).astype(cols.dtype)
-    if "bc" in kinds and symmetric:
+    if "propagate" in kinds and ncols != n:
+        # k-hop propagation chains ONE square operator; an explicit
+        # kinds=("propagate",) on a rectangular graph would otherwise
+        # die mid-trace at the second hop with a bare shape assert
+        raise ValueError(
+            f"'propagate' needs a square graph (nrows={n}, "
+            f"ncols={ncols}): A^k is undefined on rectangles"
+        )
+    if ("bc" in kinds or "propagate" in kinds) and symmetric:
         # VERIFY the symmetry claim instead of trusting it: under
-        # symmetric=True bc reuses E as its own transpose, and a
-        # forgotten symmetric=False would make every served score
-        # silently wrong (the backward sweep would walk out-edges)
+        # symmetric=True bc AND propagate reuse E as its own transpose,
+        # and a forgotten symmetric=False would make every served score
+        # silently wrong (bc's backward sweep would walk out-edges;
+        # propagate's indicator hops would aggregate the wrong side)
         tkey = np.sort(
             cols.astype(np.int64) * np.int64(ncols) + rows
         )
@@ -136,11 +167,13 @@ def _build_version(grid, rows, cols, nrows: int, ncols: int,
             )
     with obs.span("serve.load", nrows=n, nnz=int(len(rows))):
         ones = np.ones(len(rows), np.float32)
-        E = EllParMat.from_host_coo(grid, rows, cols, ones, n, ncols)
+        E = EllParMat.from_host_coo(grid, rows, cols, ones, n, ncols,
+                                    headroom=headroom)
         E_weighted = (
             EllParMat.from_host_coo(
                 grid, rows, cols,
                 np.asarray(weights, np.float32), n, ncols,
+                headroom=headroom,
             )
             if weights is not None else None
         )
@@ -157,15 +190,40 @@ def _build_version(grid, rows, cols, nrows: int, ncols: int,
                 1.0 / np.maximum(outdeg[cols], 1)
             ).astype(np.float32)
             P_ell = EllParMat.from_host_coo(
-                grid, rows, cols, pvals, n, ncols
+                grid, rows, cols, pvals, n, ncols, headroom=headroom
             )
             dangling = DistVec.from_global(
                 grid, (outdeg == 0).astype(np.float32), align="col"
             )
         ET = None
-        if "bc" in kinds and not symmetric:
+        if ("bc" in kinds or "propagate" in kinds) and not symmetric:
             ET = EllParMat.from_host_coo(grid, cols, rows, ones,
-                                         ncols, n)
+                                         ncols, n, headroom=headroom)
+        X = None
+        feat_dim = 0
+        # like every other artifact here, the feature table is built
+        # only when a served kind needs it: a features= arg whose
+        # 'propagate' was excluded (rectangular default kinds,
+        # explicit kinds=) must neither pay the [n, Fp] upload nor be
+        # validated against a contract nothing will serve
+        if features is not None and "propagate" in kinds:
+            from ..parallel.spmm import pad_features
+            from ..parallel.vec import DistMultiVec
+
+            features = np.asarray(features, np.float32)
+            if features.shape[0] != ncols:
+                raise ValueError(
+                    f"features rows {features.shape[0]} != graph "
+                    f"column space {ncols} (one feature row per "
+                    "vertex the hops aggregate from)"
+                )
+            feat_dim = int(features.shape[1])
+            # pow2 pad: propagate plans compile per padded F, so two
+            # versions inside one feature-width bucket share programs
+            X = DistMultiVec.from_global(
+                grid, pad_features(features), align="row"
+            )
+            obs.gauge("serve.propagate.feature_dim", feat_dim)
     return GraphVersion(
         nrows=n, ncols=ncols, nnz=int(len(rows)), E=E, deg=deg,
         outdeg=outdeg, E_weighted=E_weighted, P_ell=P_ell,
@@ -174,6 +232,7 @@ def _build_version(grid, rows, cols, nrows: int, ncols: int,
         # the deduped (min-combined) weights ride along for the
         # mutation lane's merge-state bootstrap
         host_weights=weights if keep_coo else None,
+        X=X, feat_dim=feat_dim, headroom=headroom,
     )
 
 
@@ -191,6 +250,7 @@ class GraphEngine:
                  E_weighted=None, P_ell=None, dangling=None, ET=None,
                  csc=None, coldeg=None, kinds: tuple[str, ...] | None = None,
                  pagerank_opts: tuple = (0.85, 1e-6, 100),
+                 propagate_opts: tuple = (2, False),
                  max_iters: int | None = None,
                  version: GraphVersion | None = None):
         self.grid = grid
@@ -225,10 +285,16 @@ class GraphEngine:
                 k for k in KINDS
                 if (k != "pagerank" or version.P_ell is not None)
                 and (k != "sssp" or weighted_given)
+                and (k != "propagate" or version.X is not None)
             )
         self._kinds = tuple(kinds)
         self.pagerank_opts = pagerank_opts
+        self.propagate_opts = propagate_opts
         self.max_iters = max_iters
+        # the SpMM backend resolves ONCE per engine through the tuner
+        # chain (op="spmm"; lazily on first propagate plan build) and
+        # stays static inside every compiled propagate plan
+        self._spmm_backend: str | None = None
         self._plans: dict[tuple[str, int], _Plan] = {}
         # whole-graph analytics cache for refresh(): (kind, root) ->
         # {vid, result, niter} — the warm-restart recompute's memory
@@ -319,7 +385,11 @@ class GraphEngine:
                  pagerank_max_iters: int = 100,
                  max_iters: int | None = None,
                  symmetric: bool = True,
-                 keep_coo: bool = False) -> "GraphEngine":
+                 keep_coo: bool = False,
+                 features=None,
+                 propagate_hops: int = 2,
+                 propagate_normalize: bool = False,
+                 headroom: float | None = None) -> "GraphEngine":
         """Load a graph from host COO and build every derived artifact
         the requested ``kinds`` need (one host pass + one upload each —
         the kernel-1 role, amortized over the engine's whole lifetime).
@@ -336,6 +406,15 @@ class GraphEngine:
         weighted edges keep the MINIMUM weight (the shortest-path
         natural combine, matching the reference's dedup-at-construction
         convention, ``SpParMat.from_global_coo dedup_sr=``).
+
+        ``features`` ([n, F] host array) opts into the ``"propagate"``
+        kind: lane w of a propagate batch returns the k-hop propagated
+        feature row of vertex w (``propagate_hops`` hops;
+        ``propagate_normalize=True`` serves the degree-normalized
+        smoothing ``(D⁻¹A)ᵏX``).  ``headroom`` reserves a slack
+        fraction of padding slots per ELL bucket class at build
+        (``COMBBLAS_DYNAMIC_HEADROOM``) so the dynamic mutation lane
+        re-buckets growing rows instead of spilling to a rebuild.
         """
         ncols = nrows if ncols is None else int(ncols)
         n = int(nrows)
@@ -344,15 +423,21 @@ class GraphEngine:
                 k for k in KINDS
                 if (k != "sssp" or weights is not None)
                 and (k != "bc" or ncols == n)  # bc needs a square graph
+                # propagate chains hops through one square operator —
+                # a rectangular graph has no A^k to serve
+                and (k != "propagate"
+                     or (features is not None and ncols == n))
             )
         version = _build_version(
             grid, rows, cols, n, ncols, weights, tuple(kinds),
-            symmetric, keep_coo,
+            symmetric, keep_coo, features=features, headroom=headroom,
         )
         return GraphEngine(
             grid, version=version, kinds=tuple(kinds),
             pagerank_opts=(pagerank_alpha, pagerank_tol,
                            pagerank_max_iters),
+            propagate_opts=(int(propagate_hops),
+                            bool(propagate_normalize)),
             max_iters=max_iters,
         )
 
@@ -360,7 +445,8 @@ class GraphEngine:
 
     def build_version(self, rows, cols, weights=None,
                       ncols: int | None = None, symmetric: bool = True,
-                      keep_coo: bool = False) -> GraphVersion:
+                      keep_coo: bool = False,
+                      features=None) -> GraphVersion:
         """Build the NEXT graph generation for this engine — same
         nrows, same kinds — entirely outside the execution lock (the
         double-buffered half of hot-swap: current version keeps
@@ -375,7 +461,17 @@ class GraphEngine:
             # distinct edges
             self._version.ncols if ncols is None else int(ncols),
             weights, self._kinds, symmetric, keep_coo,
+            features=features,
+            # bucket shapes must round-trip the swap: reuse the
+            # engine's configured headroom
+            headroom=self._version.headroom,
         )
+        if v.X is None and self._version.X is not None:
+            # features are edge-independent: a version rebuilt without
+            # an explicit new table KEEPS the served one (same device
+            # arrays — no re-upload, no retrace)
+            v.X = self._version.X
+            v.feat_dim = self._version.feat_dim
         obs.observe("serve.swap.build_s", time.perf_counter() - t0)
         return v
 
@@ -448,6 +544,12 @@ class GraphEngine:
             raise ValueError(
                 "engine serves 'pagerank' but the new version has no "
                 "P_ell; build it via engine.build_version(...)"
+            )
+        if "propagate" in self._kinds and version.X is None:
+            raise ValueError(
+                "engine serves 'propagate' but the new version has no "
+                "feature table; pass features= to build_version (or "
+                "reuse the current one via engine.build_version)"
             )
         if (
             "sssp" in self._kinds
@@ -626,6 +728,24 @@ class GraphEngine:
                     per_lane=True,
                 )
 
+        elif kind == "propagate":
+            from ..models.propagate import _propagate_batch_impl
+
+            if self._version.X is None:
+                raise ValueError(
+                    "engine was built without a feature table "
+                    "(from_coo(features=...) opts into 'propagate')"
+                )
+            hops, normalize = self.propagate_opts
+            backend = self._resolve_spmm_backend()
+
+            def impl(ET, X, invdeg, sources):
+                trace_mark()
+                return _propagate_batch_impl(
+                    ET, X, invdeg, sources, hops=hops,
+                    normalize=normalize, backend=backend,
+                )
+
         else:
             raise ValueError(f"unknown query kind {kind!r}")
 
@@ -637,6 +757,41 @@ class GraphEngine:
         plan.fn = lambda sources: jitted(*self._plan_args(kind), sources)
         return plan
 
+    def _resolve_spmm_backend(self) -> str:
+        """The op="spmm" tuner resolution, ONCE per engine (the plan
+        store remembers it across processes; the result is a static
+        closure constant of every propagate plan).
+
+        Keyed at the WIDEST warmup LANE width, not the feature-table
+        width: the plan's hot kernels are the k indicator hops over
+        the [n, W] batch block (the table enters once, in a
+        backend-independent dense dot), so a measurement cached under
+        the whole-graph F-width key would describe a different kernel
+        shape — and the two resolutions must not pollute each other's
+        store records."""
+        if self._spmm_backend is None:
+            from ..parallel.spmm import resolve_spmm_backend
+            from ..semiring import PLUS_TIMES
+
+            self._spmm_backend = resolve_spmm_backend(
+                PLUS_TIMES, self.ET, max(self.DEFAULT_WARMUP_WIDTHS),
+            )
+        return self._spmm_backend
+
+    def _propagate_invdeg(self):
+        """Col-aligned 1/deg DistVec for normalized propagation — lazy
+        per version (a merge resets it: degrees changed)."""
+        v = self._version
+        if v.invdeg is None:
+            from ..parallel.vec import DistVec
+
+            v.invdeg = DistVec.from_global(
+                self.grid,
+                (1.0 / np.maximum(v.deg, 1)).astype(np.float32),
+                align="col",
+            )
+        return v.invdeg
+
     def _plan_args(self, kind: str) -> tuple:
         """The current version's operands for one kind (the properties
         apply the unit-weight / symmetric-transpose fallbacks)."""
@@ -646,6 +801,12 @@ class GraphEngine:
             return (self.E_weighted,)
         if kind == "pagerank":
             return (self.P_ell, self.dangling)
+        if kind == "propagate":
+            _hops, normalize = self.propagate_opts
+            return (
+                self.ET, self._version.X,
+                self._propagate_invdeg() if normalize else None,
+            )
         return (self.E, self.ET)
 
     #: Lane widths every warmup covers (the batcher's pow2 buckets).
@@ -759,6 +920,14 @@ class GraphEngine:
                     "ranks": self._lanes_to_global(x),
                     "batch_niter": int(niter),
                 }
+            if kind == "propagate":
+                # [Fp, W] replicated features — strip the pow2 pad
+                # lanes back to the true feature dim; lane axis stays
+                # LAST (the batcher's scatter contract)
+                from ..parallel.spgemm import host_value
+
+                feats = host_value(res)
+                return {"features": feats[: self._version.feat_dim]}
             # bc: per-lane Brandes dependency vectors
             return {"scores": self._lanes_to_global(res)}
 

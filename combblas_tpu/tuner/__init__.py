@@ -33,6 +33,7 @@ from .store import (  # noqa: F401
     shape_bucket,
     spgemm3d_plan_key,
     spgemm_plan_key,
+    spmm_plan_key,
 )
 
 __all__ = [
@@ -49,4 +50,5 @@ __all__ = [
     "shape_bucket",
     "spgemm3d_plan_key",
     "spgemm_plan_key",
+    "spmm_plan_key",
 ]

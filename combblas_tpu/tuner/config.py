@@ -61,6 +61,14 @@ ENV_STORE_COMPACT = "COMBBLAS_PLAN_STORE_COMPACT_MIN"  # superseded-line
 #: Dynamic-graph mutation knobs (round 11, docs/dynamic.md).
 ENV_DYNAMIC_SPILL = "COMBBLAS_DYNAMIC_SPILL_FRAC"
 
+#: Round-12 knobs: the batched-SpMM backend override (the op="spmm"
+#: analog of COMBBLAS_SPGEMM_TIER) and headroom-aware bucket sizing —
+#: the slack fraction of padding slots every ELL bucket class reserves
+#: at build so high-churn dynamic graphs re-bucket instead of spilling
+#: (docs/dynamic.md; counter ``dynamic.merge.headroom_used``).
+ENV_SPMM_BACKEND = "COMBBLAS_SPMM_BACKEND"
+ENV_DYNAMIC_HEADROOM = "COMBBLAS_DYNAMIC_HEADROOM"
+
 #: Default probe budget: total measured seconds across all candidate
 #: rungs for ONE store miss (compiles excluded from the budget check
 #: only insofar as the first candidate always completes).
@@ -74,6 +82,9 @@ DEFAULT_STORE_COMPACT_MIN = 32
 #: Structural-change fraction above which ``dynamic.apply_delta``
 #: spills to a full rebuild (the incremental path's amortization bound).
 DEFAULT_DYNAMIC_SPILL_FRAC = 0.10
+#: Default bucket-slot headroom: none (static graphs pay no padding
+#: tax; dynamic engines opt in via from_coo(headroom=) or the env).
+DEFAULT_DYNAMIC_HEADROOM = 0.0
 
 
 def _str_env(name: str) -> str | None:
@@ -183,6 +194,22 @@ def store_compact_min() -> int:
     load-time compaction rewrite (``tuner.store.compacted``)."""
     v = _int_env(ENV_STORE_COMPACT)
     return DEFAULT_STORE_COMPACT_MIN if v is None else v
+
+
+def env_spmm_backend() -> str | None:
+    """Fleet-wide SpMM backend override (``mxu_gather``/``scatter``) —
+    the op="spmm" rung ``tuner.resolve.resolve_tier`` walks."""
+    return _str_env(ENV_SPMM_BACKEND)
+
+
+def dynamic_headroom(given: float | None = None) -> float:
+    """Bucket-slot headroom fraction: explicit argument >
+    ``COMBBLAS_DYNAMIC_HEADROOM`` > 0.  Clamped to >= 0 (a negative
+    headroom would under-allocate the real rows)."""
+    if given is not None:
+        return max(float(given), 0.0)
+    v = os.environ.get(ENV_DYNAMIC_HEADROOM)
+    return max(float(v), 0.0) if v else DEFAULT_DYNAMIC_HEADROOM
 
 
 def dynamic_spill_frac() -> float:

@@ -183,6 +183,8 @@ def probe_spgemm(
     host_coo_a=None,
     host_coo_b=None,
     measure=None,
+    tier_order=None,
+    geometry: bool = True,
 ) -> PlanRecord | None:
     """Measure the admissible rungs on the downsampled proxy; return
     the winning :class:`PlanRecord` (and persist it into ``store``
@@ -193,7 +195,10 @@ def probe_spgemm(
     ``host_coo_a``/``host_coo_b`` ((rows, cols, vals) host arrays) skip
     the operand readback for callers that still hold the construction
     COO (benches: the axon D2H rule).  ``measure`` injects the cost
-    functional (tests use a deterministic fake; default wall time)."""
+    functional (tests use a deterministic fake; default wall time);
+    ``tier_order`` overrides the admissibility-gated candidate list
+    and ``geometry=False`` skips the windowed block-shape sweep (both
+    for deterministic tests — production callers leave the defaults)."""
     from ..parallel.spmat import SpParMat
 
     budget_s = config.probe_budget_s() if budget_s is None else budget_s
@@ -237,7 +242,10 @@ def probe_spgemm(
 
     from ..parallel.spgemm import spgemm_auto
 
-    cands = admissible_tiers(sr, A, B, backend)
+    cands = (
+        list(tier_order) if tier_order is not None
+        else admissible_tiers(sr, A, B, backend)
+    )
     costs: dict[str, float] = {}
     spent = 0.0
     runs = 0
@@ -277,9 +285,170 @@ def probe_spgemm(
     winner = min(costs, key=costs.get)
     if obs.ENABLED:
         obs.count("tuner.probe.winner", tier=winner)
+    # -- window-geometry sweep (round 12, ROADMAP follow-up): the tier
+    # probe measured the WINDOWED rung at its default block geometry;
+    # when windowed won and budget remains, sweep a small block_rows /
+    # block_cols grid on the same proxy and persist the winning
+    # geometry WITH the plan (before this, geometry was recordable only
+    # via BENCH_PLAN_RECORD=1).  Proxy-scale geometry transfers as a
+    # measured hint — a bench-recorded real-scale plan (source="bench")
+    # overwrites it on the next record.
+    best_geo = (None, None)
+    if geometry and winner == "windowed" and spent < budget_s:
+        best_cost = costs[winner]
+        geo_runs, geo_spent = 0, 0.0
+        geo_cands = _geometry_candidates(pm, pn)
+        with obs.span("tuner.probe.geometry", dim=pm):
+            for br, bc in geo_cands:
+                if spent + geo_spent >= budget_s:
+                    if obs.ENABLED:
+                        obs.count("tuner.probe.budget_exhausted")
+                    break
+
+                def run_geo(br=br, bc=bc):
+                    return spgemm_auto(
+                        sr, pA, pB, tier="windowed", backend=backend,
+                        block_rows=br, block_cols=bc,
+                        assume_unique=True,
+                    )
+
+                try:
+                    run_geo()  # compile + warm (untimed)
+                    dt = float(measure(run_geo))
+                except Exception:
+                    if obs.ENABLED:
+                        obs.count("tuner.probe.errors", tier="windowed")
+                    continue
+                geo_spent += dt
+                geo_runs += 1
+                if obs.ENABLED:
+                    obs.count("tuner.probe.geometry_runs")
+                if dt < best_cost:
+                    best_cost, best_geo = dt, (br, bc)
+        if store is not None:
+            store.record_probe(geo_runs, geo_spent)
+        if obs.ENABLED and geo_spent:
+            obs.count("tuner.probe.seconds", geo_spent)
+        costs[winner] = best_cost
+        if best_geo != (None, None):
+            # the candidates are FRACTIONS of the proxy dims; persist
+            # them rescaled to the REAL dims the plan key describes —
+            # replaying a proxy-absolute block size at production
+            # scale would mint thousands of tiny windows (when the
+            # proxy wasn't downsampled the factor is 1: the exact
+            # measured geometry ships)
+            sm = -(-int(A.nrows) // pm)
+            sn = -(-int(B.ncols) // pn)
+            br, bc = best_geo
+            best_geo = (
+                None if br is None else int(br) * sm,
+                None if bc is None else int(bc) * sn,
+            )
     rec = PlanRecord(
         tier=winner, cost_s=costs[winner], source="probe",
         probe_dim=pm,
+        block_rows=best_geo[0], block_cols=best_geo[1],
+    )
+    if store is not None and key is not None:
+        store.put(key, rec)
+    return rec
+
+
+def _geometry_candidates(pm: int, pn: int) -> list[tuple]:
+    """Bounded non-default block-geometry grid for the windowed sweep:
+    a handful of pow2 fractions of the proxy dims (the kernel default
+    was already measured by the tier pass), deduped and capped at FOUR
+    so the sweep stays a small multiple of one tier measurement —
+    every candidate is one real compile on the proxy."""
+    brs = sorted({max(pm // 8, 16), max(pm // 2, 32)})
+    bcs = [None, max(pn // 4, 16)]
+    cands = [(br, bc) for br in brs for bc in bcs]
+    seen, out = set(), []
+    for g in cands:
+        if g not in seen and g != (None, None):
+            seen.add(g)
+            out.append(g)
+    return out[:4]
+
+
+def probe_spmm(
+    sr,
+    E,
+    X,
+    *,
+    store: PlanStore | None = None,
+    key: PlanKey | None = None,
+    budget_s: float | None = None,
+    measure=None,
+) -> PlanRecord | None:
+    """Measure the admissible SpMM backends ON THE REAL OPERANDS and
+    return / persist the winner (the op="spmm" micro-probe).
+
+    Unlike the SpGEMM probe there is no downsampled proxy: an SpMM
+    probe is at most two warm runs of a kernel the caller was about to
+    run anyway (the candidate set is {mxu_gather, scatter} for
+    plus_times, a single backend otherwise — in which case there is
+    nothing to measure and ``None`` is returned).  The heuristic's
+    choice is measured FIRST so budget exhaustion still yields a
+    measured plan; cost is obs-visible under the same
+    ``tuner.probe.*`` counters as the SpGEMM pass."""
+    from ..parallel import spmm as spmm_mod
+
+    cands = list(spmm_mod.admissible_spmm_backends(sr))
+    if len(cands) < 2:
+        return None
+    heur = spmm_mod.spmm_backend_heuristic(sr)
+    if heur in cands:
+        cands.remove(heur)
+        cands.insert(0, heur)
+    budget_s = config.probe_budget_s() if budget_s is None else budget_s
+
+    def _measure_default(fn) -> float:
+        import jax
+
+        t0 = time.perf_counter()
+        out = fn()
+        jax.block_until_ready(out.blocks)
+        return time.perf_counter() - t0
+
+    measure = _measure_default if measure is None else measure
+    costs: dict[str, float] = {}
+    spent = 0.0
+    runs = 0
+    with obs.span("tuner.probe", sr=sr.name, dim=int(E.nrows), op="spmm"):
+        for backend in cands:
+            if costs and spent >= budget_s:
+                if obs.ENABLED:
+                    obs.count("tuner.probe.budget_exhausted")
+                break
+
+            def run(backend=backend):
+                return spmm_mod.dist_spmm_ell(sr, E, X, backend=backend)
+
+            try:
+                run()  # compile + warm (untimed)
+                dt = float(measure(run))
+            except Exception:
+                if obs.ENABLED:
+                    obs.count("tuner.probe.errors", tier=backend)
+                continue
+            costs[backend] = dt
+            spent += dt
+            runs += 1
+            if obs.ENABLED:
+                obs.count("tuner.probe.runs", tier=backend)
+    if store is not None:
+        store.record_probe(runs, spent)
+    if obs.ENABLED:
+        obs.count("tuner.probe.seconds", spent)
+    if not costs:
+        return None
+    winner = min(costs, key=costs.get)
+    if obs.ENABLED:
+        obs.count("tuner.probe.winner", tier=winner)
+    rec = PlanRecord(
+        tier=winner, cost_s=costs[winner], source="probe",
+        probe_dim=int(E.nrows),
     )
     if store is not None and key is not None:
         store.put(key, rec)

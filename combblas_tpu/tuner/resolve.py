@@ -72,10 +72,12 @@ def resolve_tier(
         if rec is not None:
             tier, source = rec.tier, "store"
         if tier is None:
-            env_val = (
-                config.env_tier3d() if op == "spgemm3d"
-                else config.env_tier()
-            )
+            if op == "spgemm3d":
+                env_val = config.env_tier3d()
+            elif op == "spmm":
+                env_val = config.env_spmm_backend()
+            else:
+                env_val = config.env_tier()
             if env_val is not None:
                 tier, source = env_val, "env"
         if (

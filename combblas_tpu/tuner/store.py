@@ -48,7 +48,12 @@ from . import config
 #: records carrying another tag are ignored at load (never guessed at).
 SCHEMA = "combblas_tpu.plans/v1"
 
-_TIERS = ("mxu", "windowed", "scan", "esc", "windowed3d", "serve")
+_TIERS = (
+    "mxu", "windowed", "scan", "esc", "windowed3d", "serve",
+    # op="spmm" backends (round 12): the MXU gather-contract lane and
+    # its exact-everywhere scatter/fold fallback
+    "mxu_gather", "scatter",
+)
 
 
 def shape_bucket(dim: int) -> int:
@@ -501,6 +506,32 @@ def spgemm3d_plan_key(sr, A3, B3, backend: str) -> PlanKey:
         _host_nnz(A3), _host_nnz(B3) if B3 is not A3 else _host_nnz(A3),
         backend, f"{g.pr}x{g.pc}",
         grid3=f"{g.layers}x{g.pr}x{g.pc}", op="spgemm3d",
+    )
+
+
+def spmm_plan_key(sr, E, feat_width: int,
+                  platform: str | None = None) -> PlanKey:
+    """Plan key for the batched SpMM lane (round 12): the FEATURE-WIDTH
+    bucket rides the key's third shape slot (two products over the same
+    graph at F=64 and F=512 can rank the backends differently — the
+    MXU contraction amortizes with F, the fold does not), the density
+    band comes from the sparse operand only (the feature panel is
+    dense by construction, its band carries no information)."""
+    if platform is None:
+        import jax
+
+        platform = jax.default_backend()
+    return PlanKey(
+        op="spmm",
+        shape=(
+            shape_bucket(int(E.nrows)), shape_bucket(int(E.ncols)),
+            shape_bucket(int(feat_width)),
+        ),
+        band=(density_band(_host_nnz(E), int(E.nrows)), 0),
+        sr=sr.name,
+        backend="",
+        grid=f"{E.grid.pr}x{E.grid.pc}",
+        platform=platform,
     )
 
 

@@ -219,7 +219,13 @@ def test_bfs_batch_compact_ring_schedule():
     np.testing.assert_array_equal(l1.to_global(), l2.to_global())
 
 
-@pytest.mark.parametrize("shape", [(1, 1), (2, 2)])
+@pytest.mark.parametrize("shape", [
+    (1, 1),
+    # the multi-device variant is slow-lane (round 12, tier-1 budget);
+    # the diropt union-step's distributed path keeps coverage via
+    # test_bfs_diropt and the 1x1 representative here
+    pytest.param((2, 2), marks=pytest.mark.slow),
+])
 def test_bfs_batch_compact_diropt_matches(shape):
     """The union-frontier budgeted sparse regime (on-device lax.cond)
     produces identical levels + valid trees vs the always-dense path."""

@@ -54,7 +54,12 @@ def test_isolated_compression(rng):
     assert (dc[:3, :3].sum()) == d.sum()
 
 
-@pytest.mark.parametrize("grid_shape", [(1, 1), (2, 2)])
+@pytest.mark.parametrize("grid_shape", [
+    # 1x1 is slow-lane (round 12, tier-1 budget): kernel1_device is the
+    # DISTRIBUTED pipeline — the 2x2 case is the one that matters
+    pytest.param((1, 1), marks=pytest.mark.slow),
+    (2, 2),
+])
 def test_kernel1_device_matches_host(grid_shape):
     """Device kernel-1 builds the same graph the host path builds
     (same edge multiset after dedup, modulo the isolated-compression

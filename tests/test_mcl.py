@@ -366,6 +366,9 @@ def test_mcl_dense_matches_sparse(rng):
     assert ch_d < 1e-3 and it_d >= 1
 
 
+@pytest.mark.slow  # round 12 (tier-1 budget): randomized partition
+# variant; dense-path correctness stays tier-1 via
+# test_mcl_dense_matches_sparse / test_mcl_phased_matches_unphased
 def test_mcl_dense_random_partition(rng):
     """Dense vs sparse on a random block-structured graph (three groups)."""
     grid = Grid.make(1, 1)

@@ -202,6 +202,9 @@ def test_windowed_dot_counters_gated(rng):
         obs.reset()
 
 
+@pytest.mark.slow  # round 12 (tier-1 budget): 16 s of r9 kernel
+# compiles purely for counter bookkeeping; the zero-cost gate
+# MECHANISM stays tier-1 via the round-10/11/12 gate tests
 def test_round9_pipeline_pack_3d_counters_gated(rng):
     """ISSUE 7 satellite: the round-9 series — pipelined-carousel
     overlap count, packed-launch counters, and the 3D layers gauge —

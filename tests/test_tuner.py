@@ -271,6 +271,7 @@ def test_probe_deterministic_winner_and_persistence(tmp_path, rng):
         rec = probe_spgemm(
             PLUS_TIMES, A, A, backend="scatter", store=st, key=key,
             measure=lambda fn: next(seq),
+            geometry=False,  # tier determinism under test, not the sweep
         )
         return st, rec
 
@@ -310,6 +311,7 @@ def test_probe_real_measure_smoke(tmp_path, rng):
     key = spgemm_plan_key(PLUS_TIMES, A, A, "scatter")
     rec = probe_spgemm(
         PLUS_TIMES, A, A, backend="scatter", store=st, key=key,
+        geometry=False,  # wall-clock tier smoke; the sweep has its own tests
     )
     assert rec is not None and rec.tier in ("mxu", "windowed", "scan")
     assert rec.cost_s > 0
@@ -530,7 +532,13 @@ def test_bucket_plan_caps_shapes():
     assert fc2 == ((4, 8), (16, 1)) and oc2 == ((64, 2), (8, 128))
 
 
-@pytest.mark.parametrize("dispatch", ["auto", "blocked", "fused"])
+@pytest.mark.parametrize("dispatch", [
+    "auto", "blocked",
+    # "fused" is slow-lane (round 12, tier-1 budget): the fused
+    # one-graph kernel keeps tier-1 coverage via the ring tests and
+    # test_blocked_dispatch_matches_fused
+    pytest.param("fused", marks=pytest.mark.slow),
+])
 def test_windowed_dispatch_agreement(rng, dispatch):
     """The blocked building-block dispatch (the round-10 multi-device
     default) emits the same product as the fused graph."""
@@ -835,3 +843,54 @@ def test_resolve_tier_account_false_peeks_silently(tmp_path,
     finally:
         obs.disable()
         obs.reset()
+
+
+# --- round 12: window-geometry probing --------------------------------------
+
+
+def test_probe_geometry_sweep_records_block_shape(tmp_path, rng):
+    """When the tier sweep's winner is ``windowed`` and budget remains,
+    the probe sweeps a bounded block-geometry grid and persists the
+    winning block_rows/block_cols WITH the plan (before round 12,
+    geometry reached the store only via BENCH_PLAN_RECORD=1)."""
+    from combblas_tpu.tuner.probe import _geometry_candidates
+
+    grid = Grid.make(1, 1)
+    r, c, v = coo(rng, 128, 128, 700, dup_frac=0.0)
+    A = SpParMat.from_global_coo(grid, r, c, v, 128, 128)
+    st = PlanStore(str(tmp_path))
+    key = spgemm_plan_key(PLUS_TIMES, A, A, "scatter")
+    geo = _geometry_candidates(128, 128)
+    assert 1 <= len(geo) <= 5 and (None, None) not in geo
+    # injected costs: make "windowed" win the tier sweep (0.4 beats
+    # scan's 0.5), then make the SECOND geometry candidate the overall
+    # winner (0.05)
+    seq = iter([0.4, 0.5] + [0.9, 0.05] + [0.7] * 8)
+
+    rec = probe_spgemm(
+        PLUS_TIMES, A, A, backend="scatter", store=st, key=key,
+        tier_order=("windowed", "scan"),
+        measure=lambda fn: next(seq),
+    )
+    assert rec is not None and rec.tier == "windowed"
+    assert (rec.block_rows, rec.block_cols) == geo[1]
+    assert rec.cost_s == 0.05
+    # persisted: a fresh load replays the measured geometry
+    assert PlanStore(str(tmp_path)).lookup(key) == rec
+
+
+def test_probe_geometry_skipped_when_windowed_loses(tmp_path, rng):
+    grid = Grid.make(1, 1)
+    r, c, v = coo(rng, 64, 64, 300, dup_frac=0.0)
+    A = SpParMat.from_global_coo(grid, r, c, v, 64, 64)
+    st = PlanStore(str(tmp_path))
+    seq = iter([0.1, 0.5, 0.5, 0.5])
+
+    rec = probe_spgemm(
+        PLUS_TIMES, A, A, backend="scatter", store=st,
+        key=spgemm_plan_key(PLUS_TIMES, A, A, "scatter"),
+        tier_order=("scan", "windowed"),
+        measure=lambda fn: next(seq),
+    )
+    assert rec is not None and rec.tier == "scan"
+    assert rec.block_rows is None and rec.block_cols is None
