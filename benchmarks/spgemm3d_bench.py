@@ -13,11 +13,21 @@ one JSON line per configuration.
 
 Knobs (mirroring spgemm_bench.py):
   BENCH_SCALE / BENCH_NDEV / BENCH_REPS
-  BENCH_KERNEL      esc (default) | windowed — the per-layer local kernel
-                    (windowed = the round-9 sort-free tier,
-                    ``spgemm3d_windowed``; backend via
+  BENCH_KERNEL      esc (default) | windowed | auto — the per-layer
+                    local kernel (windowed = the round-9 sort-free
+                    tier, ``spgemm3d_windowed``; backend via
                     COMBBLAS_SPGEMM_BACKEND)
+  BENCH_RING=1      per-layer carousel schedule (round 13: the 3D
+                    SUMMA now pipelines like the 2D rings); unset =
+                    let the plan record / kernel default decide
+  BENCH_PIPELINE=0  pin the carousel's rotate→compute→rotate serial
+                    chain (the A/B measurement control)
+  BENCH_MERGE       sort | runs | hash — the fiber-reduce combine
+                    tier (round 13); unset = the library's
+                    arg > store > env > heuristic resolution
   BENCH_EDGEFACTOR  R-MAT edge factor (default 8)
+  BENCH_L           comma list of layer counts to sweep (default
+                    "1,2,4,8"); capture runs pin one configuration
   BENCH_GOLDEN=1    verify each configuration EXACTLY against the scipy
                     A² golden (nnz + integer count values); defaults ON
                     up to scale 14, OFF above (the host golden is the
@@ -54,6 +64,26 @@ if os.environ.get("BENCH_PLAN_STORE") is not None:
     os.environ["COMBBLAS_PLAN_STORE"] = os.environ["BENCH_PLAN_STORE"]
 PLAN_RECORD = os.environ.get("BENCH_PLAN_RECORD", "0") == "1"
 EDGEFACTOR = int(os.environ.get("BENCH_EDGEFACTOR", "8"))
+# round-13 schedule/merge knobs (spgemm_bench parity): tri-state —
+# unset defers to the library's plan-record / kernel defaults
+_ring_env = os.environ.get("BENCH_RING", "")
+RING = None if _ring_env == "" else _ring_env == "1"
+_pipe_env = os.environ.get("BENCH_PIPELINE", "")
+PIPELINE = None if _pipe_env == "" else _pipe_env == "1"
+MERGE = os.environ.get("BENCH_MERGE", "") or None
+if MERGE not in (None, "sort", "runs", "hash"):
+    # vetted at the knob (round-12 SPMM_BACKEND precedent): a typo'd
+    # BENCH_MERGE must not die in a bare library assert (stripped
+    # under -O) nor persist an invalid plan record
+    raise ValueError(
+        f"BENCH_MERGE must be sort|runs|hash; got {MERGE!r}"
+    )
+_RINGTAG = (
+    "" if RING is None
+    else (("_ring" if PIPELINE in (None, True) else "_ringserial")
+          if RING else "_noring")
+)
+_MERGETAG = f"_{MERGE}" if MERGE else ""
 # golden scipy A² per configuration: default ON only at sweep scales
 # where the host product is cheap — above scale 14 the ~1e9-nnz golden
 # dominates (or OOMs) the run, so it becomes opt-in (env always wins)
@@ -130,8 +160,12 @@ def run() -> dict:
 
     store = tuner_store.get_store()
 
+    layer_counts = tuple(
+        int(x) for x in os.environ.get("BENCH_L", "1,2,4,8").split(",")
+        if x.strip()
+    )
     configs = []
-    for L in (1, 2, 4, 8):
+    for L in layer_counts:
         if NDEV % L:
             continue
         p2 = NDEV // L
@@ -170,8 +204,19 @@ def run() -> dict:
             heuristic="esc", tier=forced, store=store, account=False,
         )
 
+        # merge provenance mirror: an explicit BENCH_MERGE wins; else a
+        # store-routed record's remembered merge; else the library's
+        # env/heuristic rung decides inside ("auto" here)
+        merge_prov = MERGE or (
+            _rec.merge if (_rec is not None and plan_source == "store")
+            else None
+        ) or "auto"
+
         def mult():
-            return spgemm3d(PLUS_TIMES, A3, B3, tier=forced)
+            return spgemm3d(
+                PLUS_TIMES, A3, B3, tier=forced, merge=MERGE,
+                ring=RING, pipeline=PIPELINE,
+            )
 
         C = mult()  # warmup/compile + sizes caches
         jax.block_until_ready(C.vals)
@@ -183,7 +228,7 @@ def run() -> dict:
         rec = {
             "metric": (
                 f"spgemm3d_AxA_scale{SCALE}{_EFTAG}_{KERNEL}"
-                f"_L{L}x{pr}x{pc}"
+                f"{_RINGTAG}{_MERGETAG}_L{L}x{pr}x{pc}"
             ),
             "value": round(dt * 1e3, 1),
             "unit": "ms",
@@ -191,6 +236,9 @@ def run() -> dict:
             "ndev": NDEV,
             "kernel": KERNEL,
             "tier": tier,
+            "merge": merge_prov,
+            "ring": RING,
+            "pipeline": PIPELINE,
             "plan_source": plan_source,
             "plan_key_grid3": f"{L}x{pr}x{pc}",
         }
@@ -241,6 +289,13 @@ def run() -> dict:
             store.put(best_key, tuner_store.PlanRecord(
                 tier=best["tier"], cost_s=best["value"] / 1e3,
                 source="bench",
+                # schedule/merge provenance rides the record (round
+                # 13): only knobs the bench actually forced persist —
+                # an "auto" merge stays None so replay re-resolves
+                merge=MERGE,
+                ring=bool(RING) if RING is not None else False,
+                pipeline=bool(PIPELINE) if PIPELINE is not None
+                else True,
             ))
     if obs.ENABLED:
         obs.dump_jsonl()
@@ -250,7 +305,11 @@ def run() -> dict:
         "median": vals_ms[(len(vals_ms) - 1) // 2],
         "warning": warning,
         "plan_source": best["plan_source"],
-        "plan": {"tier": best["tier"], "grid3": best["plan_key_grid3"]},
+        "plan": {
+            "tier": best["tier"], "grid3": best["plan_key_grid3"],
+            "merge": best["merge"], "ring": best["ring"],
+            "pipeline": best["pipeline"],
+        },
         "tuner": None if store is None else store.stats(),
     }
 

@@ -712,13 +712,17 @@ def _mcl3d_iter_device(A3, caps, inflation, prune_kwargs):
         A3, "row", stage_capacity=stage_cap, tile_capacity=tile_cap
     )
     flop_need = jnp.max(summa3d_stage_flops(A3, B3))
-    C3 = summa3d_spgemm(
+    C3, ov3 = summa3d_spgemm(
         PLUS_TIMES, A3, B3,
         flop_capacity=fcap, out_capacity=ocap, piece_capacity=pcap,
     )
     # out-capacity overflow signature: a tile filled to the brim (compact
     # clamps at capacity, so nnz == cap marks possible truncation)
     ov_out = jnp.max((C3.nnz >= ocap).astype(jnp.int32))
+    # fiber piece drops (round 13: the exchange now REPORTS them
+    # per-kernel) fold into the same reroll bit as the expansion flops
+    # — both double fcap+pcap
+    ov_piece = (ov3[0] > 0).astype(jnp.int32)
     C3 = mcl_prune_recovery_select3d(C3, device_gate=True, **prune_kwargs)
     C3 = make_col_stochastic3d(C3)
     ch = chaos3d(C3)
@@ -728,7 +732,7 @@ def _mcl3d_iter_device(A3, caps, inflation, prune_kwargs):
     # 2 = expansion flops, 4 = output keys
     overflow = (
         (dropped > 0).astype(jnp.int32)
-        + (flop_need > fcap).astype(jnp.int32) * 2
+        + jnp.maximum((flop_need > fcap).astype(jnp.int32), ov_piece) * 2
         + ov_out * 4
     )
     return A_next, ch, overflow

@@ -376,6 +376,60 @@ name                                  kind     meaning
                                                ``block_cols`` in its
                                                plan record)
 ====================================  =======  =========================
+
+Merge-tier / 3D-carousel series (round 13 — the sort-free fiber
+reduce and the carousel-pipelined per-layer 3D SUMMA;
+docs/spgemm.md "merge tiers"):
+
+====================================  =======  =========================
+name                                  kind     meaning
+====================================  =======  =========================
+``spgemm.merge.tier``                 counter  combine-merge tier each
+                                               merge-consuming entry
+                                               resolved (labels
+                                               ``tier`` = sort / runs
+                                               / hash, ``source`` =
+                                               arg / store / env /
+                                               probe / heuristic /
+                                               hash_fallback, with a
+                                               ``_degraded`` suffix
+                                               when a forced hash on a
+                                               generic monoid degraded
+                                               to runs at the knob,
+                                               and ``op``)
+``spgemm.merge.hash_overflow``        counter  entries the hash tier's
+                                               bounded table failed to
+                                               place (the product
+                                               transparently reruns
+                                               through the sorted-runs
+                                               tier — this counter is
+                                               how a mis-routed plan
+                                               gets noticed)
+``spgemm.summa3d.piece_overflow``     counter  fiber-exchange entries
+                                               that exceeded
+                                               piece_capacity (the
+                                               entry RAISES naming the
+                                               slack knob; round-13
+                                               bugfix — previously
+                                               detected but silently
+                                               ignored by callers)
+``trace.summa3d_spgemm``              counter  TRACE-TIME: ESC 3D
+                                               SUMMA (re)traces,
+                                               labels ``ring`` /
+                                               ``merge``
+``trace.summa3d_spgemm_windowed``     counter  gains ``ring`` /
+                                               ``merge`` labels (the
+                                               per-layer carousel)
+``spgemm.pipeline.stages_overlapped`` counter  now ALSO emitted by the
+                                               3D kernels' pipelined
+                                               rings (p−1 per layer
+                                               program per compiled
+                                               trace, same trace-time
+                                               convention)
+``trace.summa_spgemm``                counter  gains the ``merge``
+                                               label (2D ESC
+                                               stage-chunk combine)
+====================================  =======  =========================
 """
 
 from __future__ import annotations

@@ -513,6 +513,246 @@ def support_window_counts(
     return jax.ops.segment_sum(per_rh, g, num_segments=nblocks)
 
 
+# --- structure-aware merges (round 13: the sort-free combine tiers) ---------
+#
+# Every distributed SpGEMM schedule ends in the same step: combine the
+# partial-product pieces that land on a device (SUMMA stage chunks, 3D
+# fiber pieces) into one compacted tile.  The classic path is
+# concat + full ``lax.sort`` (``SpTuples.compact``) — O(nnz·log nnz)
+# comparisons over the WHOLE concatenation, re-deriving order the
+# pieces already have.  The reference's distributed hash-SpGEMM (the
+# 4.88 s scale-22 bar, SURVEY §2.2) never pays that sort; these two
+# tiers are its TPU-native analogs:
+#
+#   ``merge_sorted_runs``  pieces that are already (row, col)-sorted
+#                          (windowed-tier extractions, pre-sorted fiber
+#                          pieces) merge by rank-space union — each
+#                          element finds its output slot with
+#                          lexicographic binary searches against the
+#                          OTHER runs (O(nnz·log L) search levels), no
+#                          sort anywhere.  Bit-exact with concat+sort
+#                          for every semiring: equal keys stay in run
+#                          order, so the segmented fold sees the same
+#                          operand order.
+#   ``hash_merge``         high-collision reduces combine through a
+#                          bounded open-addressing table (scatter-probe
+#                          claim, semiring combine on hit) — O(nnz)
+#                          expected work independent of run count, with
+#                          a COUNTED overflow so callers fall back to
+#                          the sorted merge (never wrong, only slower).
+
+
+def _lex_searchsorted(rs: Array, cs: Array, rq: Array, cq: Array,
+                      side: str = "left") -> Array:
+    """Vectorized ``searchsorted`` over LEXICOGRAPHIC (row, col) keys:
+    for each query (rq, cq), the count of entries in the sorted run
+    (rs, cs) strictly less than it (``side="left"``) or
+    less-or-equal (``side="right"``).
+
+    A single fused int key overflows int32 for large tiles
+    (row·ncols + col exceeds 2^31 well inside the windowed envelope),
+    so the comparison stays two-key; the binary search runs
+    ceil(log2(n+1)) vectorized steps of one gather each — the same
+    in-register search pattern as ``sparsify``."""
+    assert side in ("left", "right"), side
+    n = rs.shape[0]
+    lo = jnp.zeros(rq.shape, jnp.int32)
+    hi = jnp.full(rq.shape, n, jnp.int32)
+    nsteps = max(int(np.ceil(np.log2(n + 1))), 1)
+    for _ in range(nsteps):
+        mid = (lo + hi) >> 1
+        rm = rs[jnp.minimum(mid, n - 1)]
+        cm = cs[jnp.minimum(mid, n - 1)]
+        if side == "left":
+            before = (rm < rq) | ((rm == rq) & (cm < cq))
+        else:
+            before = (rm < rq) | ((rm == rq) & (cm <= cq))
+        adv = (lo < hi) & before
+        ret = (lo < hi) & ~before
+        lo = jnp.where(adv, mid + 1, lo)
+        hi = jnp.where(ret, mid, hi)
+    return lo
+
+
+def _merge_two_sorted(x: SpTuples, y: SpTuples) -> SpTuples:
+    """Merge two (row, col)-sorted tiles (padding sentinels at the
+    tail) into one sorted tile of capacity ``x.capacity + y.capacity``.
+
+    Rank-space union: x[i]'s output slot is ``i + |{y < x[i]}|`` and
+    y[j]'s is ``j + |{x <= y[j]}|`` — a permutation by construction
+    (ties resolve x-before-y, preserving concat order, so a downstream
+    segmented fold is BIT-EXACT with the concat+sort path even for
+    order-sensitive float accumulation).  Sentinel slots (row == nrows)
+    compare greater than every valid key and equal to each other, so
+    they land — x's first, then y's — on the output tail: padding
+    stays a suffix and ``valid_mask`` semantics survive."""
+    assert (x.nrows, x.ncols) == (y.nrows, y.ncols), (x, y)
+    mx, my = x.capacity, y.capacity
+    px = jnp.arange(mx, dtype=jnp.int32) + _lex_searchsorted(
+        y.rows, y.cols, x.rows, x.cols, side="left"
+    )
+    py = jnp.arange(my, dtype=jnp.int32) + _lex_searchsorted(
+        x.rows, x.cols, y.rows, y.cols, side="right"
+    )
+
+    def weave(ax, ay):
+        out = jnp.zeros((mx + my,), ax.dtype)
+        out = out.at[px].set(ax, unique_indices=True)
+        return out.at[py].set(ay, unique_indices=True)
+
+    return SpTuples(
+        rows=weave(x.rows, y.rows),
+        cols=weave(x.cols, y.cols),
+        vals=weave(x.vals, y.vals),
+        nnz=x.nnz + y.nnz,
+        nrows=x.nrows, ncols=x.ncols,
+    )
+
+
+def merge_sorted_runs(runs: list[SpTuples]) -> SpTuples:
+    """k-way merge of (row, col)-sorted same-shape tiles into ONE
+    sorted tile (duplicates preserved, adjacent) — the sort-free
+    replacement for ``SpTuples.concat(runs).sort_rowmajor()``.
+
+    Pairwise tree merge: ceil(log2(L)) levels of ``_merge_two_sorted``
+    rank-space unions, O(total · log L) binary-search levels instead of
+    the full sort's O(total · log total) comparison passes — and each
+    level is gathers + two scatters, which the CPU/TPU backends serve
+    far faster than ``lax.sort``'s data-movement passes.  Adjacent
+    pairing keeps ties in ascending run order at every level, so the
+    output's duplicate groups appear in EXACT concat order (the
+    bit-exactness contract callers' ``compact(assume_sorted=True)``
+    relies on).  Callers must guarantee each run is individually
+    sorted; ``mesh3d._fiber_exchange(sort_pieces=True)`` is the
+    pre-sort for producers that aren't."""
+    assert runs, "merge_sorted_runs needs at least one run"
+    while len(runs) > 1:
+        nxt = [
+            _merge_two_sorted(runs[i], runs[i + 1])
+            for i in range(0, len(runs) - 1, 2)
+        ]
+        if len(runs) % 2:
+            nxt.append(runs[-1])
+        runs = nxt
+    return runs[0]
+
+
+def hash_table_capacity(out_capacity: int) -> int:
+    """Static open-addressing table size for ``hash_merge``: the next
+    pow2 at or above 4× the distinct-key bound keeps the load factor
+    ≤ 0.25.  With double hashing the chance an element exhausts k
+    probes is ≈ α^k, so α=0.25 with the default 16 rounds puts the
+    expected overflow (→ sorted-merge rerun) below 1e-9 per element —
+    the fallback stays a safety net, not a steady-state tax.  (2×/8
+    rounds measured ~3e-4 per element: one rerun per few thousand
+    entries, far too hot for the multi-million-entry reduces this
+    tier targets.)"""
+    return 1 << max(int(4 * max(out_capacity, 8)) - 1, 1).bit_length()
+
+
+def hash_merge(
+    sr: Semiring,
+    t: SpTuples,
+    *,
+    out_capacity: int,
+    table_capacity: int,
+    n_probes: int = 16,
+) -> tuple[SpTuples, Array, Array]:
+    """Combine duplicate (row, col) keys of ``t`` through a bounded
+    open-addressing table — the hash-accumulator merge tier
+    (≈ the reference's distributed hash-SpGEMM combine, SURVEY §2.2,
+    with the per-column dynamic table replaced by ONE fixed
+    ``table_capacity`` buffer and data-parallel scatter probing).
+
+    Per probe round (static unroll, double hashing over the pow2
+    table): unplaced elements gather their slot's key; empty slots are
+    CLAIMED by a scatter-min winner which installs its key; every
+    element whose slot now holds ITS key folds its value in with the
+    add monoid's native scatter combiner and retires.  Elements still
+    unplaced after ``n_probes`` rounds are COUNTED, not dropped —
+    callers watch the overflow and rerun through the sorted-merge
+    tier (never wrong, only slower).
+
+    Returns ``(out, overflow, distinct)``: ``out`` is the compacted
+    (UNSORTED — table-order) tile truncated to ``out_capacity``;
+    ``distinct`` is the exact distinct-nonzero-key count so callers
+    detect out_capacity truncation the usual way.  Only defined for
+    semirings with a native scatter combiner."""
+    comb = scatter_combine_for(sr)
+    assert comb is not None, (
+        f"semiring {sr.name} (add_kind={sr.add_kind}) has no scatter "
+        "combiner; use merge_sorted_runs / the sort path"
+    )
+    T = int(table_capacity)
+    assert T >= 2 and T & (T - 1) == 0, f"table capacity {T} not pow2"
+    cap = t.capacity
+    valid = t.valid_mask()
+    zero = sr.zero(t.vals.dtype)
+
+    def _mix(x):
+        # finalizer-style avalanche (splitmix32 constants): adjacent
+        # (row, col) keys — the common case for sorted pieces — must
+        # not probe adjacent slots in lockstep
+        x = (x ^ (x >> 16)) * jnp.uint32(0x7FEB352D)
+        x = (x ^ (x >> 15)) * jnp.uint32(0x846CA68B)
+        return x ^ (x >> 16)
+
+    k = (
+        t.rows.astype(jnp.uint32) * jnp.uint32(0x9E3779B1)
+        + t.cols.astype(jnp.uint32) * jnp.uint32(0x85EBCA77)
+    )
+    h0 = (_mix(k) & jnp.uint32(T - 1)).astype(jnp.int32)
+    # odd step cycles the whole pow2 table (double hashing)
+    step = (
+        (_mix(k ^ jnp.uint32(0xC2B2AE35)) | jnp.uint32(1))
+        & jnp.uint32(T - 1)
+    ).astype(jnp.int32) | 1
+    t_rows = jnp.full((T,), t.nrows, jnp.int32)
+    t_cols = jnp.full((T,), t.ncols, jnp.int32)
+    t_vals = jnp.full((T,), zero, t.vals.dtype)
+    slot_ids = jnp.arange(cap, dtype=jnp.int32)
+    placed = ~valid
+    slot = h0
+    for round_ in range(n_probes):
+        if round_:
+            slot = (slot + step) & (T - 1)
+        active = ~placed
+        empty = t_rows[slot] == t.nrows
+        # claim: lowest proposing element index wins each empty slot
+        prop = jnp.where(active & empty, slot, T)
+        winner = jnp.full((T,), cap, jnp.int32).at[prop].min(
+            slot_ids, mode="drop"
+        )
+        inst = active & empty & (winner[slot] == slot_ids)
+        # distinct OOB sentinels for non-installers (densify's
+        # unique_indices convention)
+        inst_slot = jnp.where(inst, slot, T + slot_ids)
+        t_rows = t_rows.at[inst_slot].set(
+            t.rows, mode="drop", unique_indices=True
+        )
+        t_cols = t_cols.at[inst_slot].set(
+            t.cols, mode="drop", unique_indices=True
+        )
+        # combine into any slot now holding MY key (the installer and
+        # every duplicate retire together)
+        match = active & (t_rows[slot] == t.rows) & (t_cols[slot] == t.cols)
+        t_vals = getattr(
+            t_vals.at[jnp.where(match, slot, T)], comb
+        )(t.vals, mode="drop")
+        placed = placed | match
+    overflow = jnp.sum(~placed).astype(jnp.int32)
+    table = SpTuples(
+        rows=t_rows, cols=t_cols, vals=t_vals,
+        nnz=jnp.sum(t_rows < t.nrows).astype(jnp.int32),
+        nrows=t.nrows, ncols=t.ncols,
+    )
+    # compact + prune additive identities (compact's prune_zeros
+    # semantics), then truncate to the caller's static output shape
+    out = table._select((t_rows < t.nrows) & (t_vals != zero))
+    distinct = out.nnz
+    return out.with_capacity(out_capacity), overflow, distinct
+
+
 # --- bit-packed output-support oracle ---------------------------------------
 
 

@@ -251,8 +251,16 @@ class SpTuples:
         )
         return out.prune_zeros(sr), distinct
 
-    def compact(self, sr: Semiring, *, capacity: int | None = None) -> "SpTuples":
-        out, _ = self.compact_counted(sr, capacity=capacity)
+    def compact(
+        self,
+        sr: Semiring,
+        *,
+        capacity: int | None = None,
+        assume_sorted: bool = False,
+    ) -> "SpTuples":
+        out, _ = self.compact_counted(
+            sr, capacity=capacity, assume_sorted=assume_sorted
+        )
         return out
 
     def prune_zeros(self, sr: Semiring) -> "SpTuples":

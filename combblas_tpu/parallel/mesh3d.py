@@ -416,15 +416,24 @@ def mem_efficient_spgemm3d(
 
 
 def _fiber_exchange(partial_c: SpTuples, L: int, w_out: int,
-                    piece_capacity: int):
+                    piece_capacity: int, *, sort_pieces: bool = False):
     """Fiber exchange of one layer's partial product: split its local
     cols into L pieces of width ``w_out`` (rebased to piece-local
-    columns), ``all_to_all`` them over the layer axis, and stitch the
-    received pieces into one [nrows × w_out] merge input.  The fiber
+    columns) and ``all_to_all`` them over the layer axis.  The fiber
     Alltoallv of ``ParFriends.h:3119-3180``, shared by the ESC and
-    windowed 3D kernels.  Returns (merged tuples, piece overflow — the
-    max count of entries a piece had to DROP to fit
-    ``piece_capacity``; zero means the exchange was lossless)."""
+    windowed 3D kernels.  Returns (received piece runs — one sorted or
+    order-preserved [piece_capacity] SpTuples per source layer — and
+    the piece overflow: the max count of entries a piece had to DROP
+    to fit ``piece_capacity``; zero means the exchange was lossless).
+    Callers combine the runs with ``_fiber_merge``.
+
+    ``sort_pieces=True`` row-major-sorts each OUTGOING piece before the
+    exchange — the pre-sort the ``merge="runs"`` tier needs when the
+    producing kernel's partial is not already (row, col)-sorted (ESC
+    stage chunks, 2D-windowed dot2d chunk order).  L piece-local sorts
+    are strictly cheaper than the one concat-sized sort they replace,
+    and they ride the exchange side where the partial is still
+    column-partitioned."""
     lr = partial_c.nrows
     piece_arrays = []
     worst = jnp.int32(0)
@@ -439,7 +448,14 @@ def _fiber_exchange(partial_c: SpTuples, L: int, w_out: int,
         worst = jnp.maximum(worst, nkeep - piece_capacity)
         sel = partial_c._select(keep).with_capacity(piece_capacity)
         cols = jnp.where(sel.valid_mask(), sel.cols - lo, w_out)
-        piece_arrays.append((sel.rows, cols, sel.vals, sel.nnz))
+        piece = SpTuples(
+            rows=sel.rows, cols=cols, vals=sel.vals, nnz=sel.nnz,
+            nrows=lr, ncols=w_out,
+        )
+        if sort_pieces:
+            piece = piece.sort_rowmajor()
+        piece_arrays.append((piece.rows, piece.cols, piece.vals,
+                             piece.nnz))
 
     stacked = tuple(
         jnp.stack([pa[k] for pa in piece_arrays])
@@ -449,20 +465,101 @@ def _fiber_exchange(partial_c: SpTuples, L: int, w_out: int,
         lax.all_to_all(x, LAYER_AXIS, split_axis=0, concat_axis=0)
         for x in stacked
     )
-    merged = SpTuples(
-        rows=received[0].reshape(-1),
-        cols=received[1].reshape(-1),
-        vals=received[2].reshape(-1),
-        nnz=jnp.sum(received[3]).astype(jnp.int32),
-        nrows=lr,
-        ncols=w_out,
+    runs = [
+        SpTuples(
+            rows=received[0][l_], cols=received[1][l_],
+            vals=received[2][l_], nnz=received[3][l_],
+            nrows=lr, ncols=w_out,
+        )
+        for l_ in range(L)
+    ]
+    return runs, worst
+
+
+#: Valid fiber-reduce combine tiers (docs/spgemm.md "merge tiers") —
+#: the ONE definition lives with the env vetting in tuner/config.py.
+from ..tuner.config import MERGE_TIER_NAMES as MERGE_TIERS  # noqa: E402
+
+#: Probe rounds of the hash merge tier before the counted overflow
+#: fallback kicks in (load factor <= 0.25 via ``hash_table_capacity``
+#: puts the per-element exhaustion odds near alpha^k ~ 1e-10 at this
+#: budget — the fallback is a safety net, not a steady-state path).
+HASH_MERGE_PROBES = 16
+
+
+def _fiber_merge(
+    sr: Semiring,
+    runs: list[SpTuples],
+    out_capacity: int,
+    merge: str,
+):
+    """Combine the received fiber piece runs into one compacted tile —
+    the merge half of the fiber reduce, in the selected tier:
+
+      ``sort``  concat + full ``lax.sort`` compact (the classic path);
+      ``runs``  k-way rank-space union of the (pre)sorted runs
+                (``ops.spgemm.merge_sorted_runs``) + sort-free compact;
+      ``hash``  bounded open-addressing accumulate
+                (``ops.spgemm.hash_merge``) — unsorted output order.
+
+    Returns ``(out, merge_over, hash_over)``: ``merge_over`` > 0 means
+    the distinct-key count exceeded ``out_capacity`` (truncation),
+    ``hash_over`` > 0 means the hash table failed to place entries
+    (the caller MUST fall back to a sorted tier — the output is
+    incomplete)."""
+    from ..ops.spgemm import hash_merge, hash_table_capacity, \
+        merge_sorted_runs
+
+    if merge == "runs":
+        merged = merge_sorted_runs(runs)
+        out, distinct = merged.compact_counted(
+            sr, capacity=out_capacity, assume_sorted=True
+        )
+        return out, distinct - out_capacity, jnp.int32(0)
+    if merge == "hash":
+        out, hash_over, distinct = hash_merge(
+            sr, SpTuples.concat(runs), out_capacity=out_capacity,
+            table_capacity=hash_table_capacity(out_capacity),
+            n_probes=HASH_MERGE_PROBES,
+        )
+        return out, distinct - out_capacity, hash_over
+    assert merge == "sort", merge
+    out, distinct = SpTuples.concat(runs).compact_counted(
+        sr, capacity=out_capacity
     )
-    return merged, worst
+    return out, distinct - out_capacity, jnp.int32(0)
+
+
+def _merge_heuristic(sr: Semiring, L: int, expansion_ratio: float,
+                     pieces_sorted: bool) -> str:
+    """The merge-tier heuristic rung (arg > store > env > THIS):
+    ``runs`` when the pieces arrive already sorted — the windowed
+    tiers' structural freebie (no sort anywhere in the reduce; the
+    r13 capture's 1.87x) always beats speculating on the hash table;
+    ``hash`` for UNSORTED producers at high layer counts with heavy
+    cross-layer collision (expansion_ratio ≈ total piece slots /
+    distinct bound), where the open-addressing combine's O(nnz) beats
+    both the pre-sorts and the one concat sort; ``sort`` otherwise
+    (unsorted producers at low L — the r13 scale-12 sweep measured
+    the piece pre-sort + union LOSING to the one concat sort at L=2,
+    benchmarks/results/r13/).  CPU-mesh-measured thresholds; the
+    plan store / probe override per key, and a TPU re-measure is an
+    open ROADMAP item."""
+    from ..ops.spgemm import scatter_combine_for
+
+    if pieces_sorted:
+        return "runs"
+    if scatter_combine_for(sr) is not None and (
+        L >= 4 and expansion_ratio >= 4.0
+    ):
+        return "hash"
+    return "sort"
 
 
 @partial(
     jax.jit,
-    static_argnames=("sr", "flop_capacity", "out_capacity", "piece_capacity"),
+    static_argnames=("sr", "flop_capacity", "out_capacity",
+                     "piece_capacity", "ring", "merge"),
 )
 def summa3d_spgemm(
     sr: Semiring,
@@ -472,7 +569,9 @@ def summa3d_spgemm(
     flop_capacity: int,
     out_capacity: int,
     piece_capacity: int,
-) -> SpParMat3D:
+    ring: bool = False,
+    merge: str = "sort",
+) -> tuple[SpParMat3D, Array]:
     """C (col-split) = A (col-split) ⊗ B (row-split) over the 3D mesh.
 
     Reference: ``Mult_AnXBn_SUMMA3D`` (ParFriends.h:2919-3213). Layer l
@@ -484,9 +583,24 @@ def summa3d_spgemm(
 
     ``flop_capacity``: one stage's expansion per tile; ``piece_capacity``:
     one outgoing fiber piece per tile; ``out_capacity``: final tile nnz.
+
+    ``ring=True`` runs each layer's 2D SUMMA as the STAGE-PIPELINED
+    carousel (``spgemm._carousel_stages``: two-slot neighbor-rotation
+    buffers on the within-layer joint (row, col) axis, stage s+1's
+    ppermute issued before stage s's expand consumes its tiles) instead
+    of the up-front all_gathers — O(2·tile) sparse operand memory per
+    layer, the r9 schedule the 3D tier was missing.  ``merge`` picks
+    the fiber-reduce combine tier (``MERGE_TIERS``; ESC stage chunks
+    are unsorted, so ``"runs"`` pre-sorts each outgoing piece).
+
+    Returns ``(C, overflow[3])``: the per-device max of (fiber piece
+    drop, merge distinct-keys − out_capacity, hash placement
+    overflow) — all ≤ 0 means the product is exact; a positive hash
+    overflow means the CALLER must rerun through a sorted tier.
     """
     assert A.split == "col" and B.split == "row"
     assert A.grid == B.grid and A.ncols == B.nrows
+    assert merge in MERGE_TIERS, merge
     grid = A.grid
     p = grid.pr
     assert grid.pr == grid.pc, "SUMMA3D requires square layer grids"
@@ -496,38 +610,67 @@ def summa3d_spgemm(
     assert A.tile_cols == B.tile_rows, "contraction blocking mismatch"
     assert lcB % L == 0
     w_out = lcB // L
+    if obs.ENABLED:
+        # trace-time (jitted fn): counts (re)traces per static config
+        obs.count("trace.summa3d_spgemm", ring=ring, merge=merge)
+        if ring and p > 1:
+            obs.count("spgemm.pipeline.stages_overlapped", p - 1)
 
     def body(ar, ac, av, an, br, bc, bv, bn):
-        from .spgemm import _gather_stage_tiles
+        from .spgemm import _carousel_stages, _gather_stage_tiles
 
         a_mine = A.local_tile(ar, ac, av, an)
         b_mine = B.local_tile(br, bc, bv, bn)
-        a_stages = _gather_stage_tiles(a_mine, COL_AXIS, p)
-        b_stages = _gather_stage_tiles(b_mine, ROW_AXIS, p)
-        chunks = [
-            esc_expand(sr, a_stages[s], CSR.from_tuples(b_stages[s]),
-                       flop_capacity)
-            for s in range(p)
-        ]
+        if ring:
+            # per-layer carousel: the joint (row, col) ppermute acts
+            # within each layer automatically (axis names ARE the
+            # subcommunicators), so the 2D rotation schedule lifts to
+            # the 3-axis mesh unchanged
+            chunks = [
+                esc_expand(sr, a_cur, CSR.from_tuples(b_cur),
+                           flop_capacity)
+                for _, a_cur, b_cur in _carousel_stages(
+                    a_mine, b_mine, p
+                )
+            ]
+        else:
+            a_stages = _gather_stage_tiles(a_mine, COL_AXIS, p)
+            b_stages = _gather_stage_tiles(b_mine, ROW_AXIS, p)
+            chunks = [
+                esc_expand(sr, a_stages[s], CSR.from_tuples(b_stages[s]),
+                           flop_capacity)
+                for s in range(p)
+            ]
         partial_c = SpTuples.concat(chunks)  # [lr × lcB] partial, uncompacted
-        merged, _ = _fiber_exchange(partial_c, L, w_out, piece_capacity)
-        out = merged.compact(sr, capacity=out_capacity)
+        runs, piece_over = _fiber_exchange(
+            partial_c, L, w_out, piece_capacity,
+            sort_pieces=(merge == "runs"),
+        )
+        out, merge_over, hash_over = _fiber_merge(
+            sr, runs, out_capacity, merge
+        )
+        overflow = jnp.stack([piece_over, merge_over, hash_over])
+        overflow = lax.pmax(
+            lax.pmax(lax.pmax(overflow, ROW_AXIS), COL_AXIS), LAYER_AXIS
+        )
         return (
             out.rows[None, None, None], out.cols[None, None, None],
             out.vals[None, None, None], out.nnz[None, None, None],
+            overflow[None, None, None],
         )
 
-    r, c, v, n = jax.shard_map(
+    r, c, v, n, overflow = jax.shard_map(
         body,
         mesh=grid.mesh,
         in_specs=(TILE3_SPEC,) * 8,
-        out_specs=(TILE3_SPEC,) * 4,
+        out_specs=(TILE3_SPEC,) * 5,
         check_vma=False,
     )(A.rows, A.cols, A.vals, A.nnz, B.rows, B.cols, B.vals, B.nnz)
-    return SpParMat3D(
+    mat = SpParMat3D(
         rows=r, cols=c, vals=v, nnz=n,
         nrows=A.nrows, ncols=B.ncols, split="col", grid=grid,
     )
+    return mat, overflow[0, 0, 0]
 
 
 @jax.jit
@@ -774,7 +917,7 @@ def windowed_plan3d(
     static_argnames=(
         "sr", "block_rows", "flop_caps", "out_caps", "skip", "backend",
         "mode", "chunk_w", "interpret", "block_cols", "panel_cap",
-        "piece_capacity", "out_capacity",
+        "piece_capacity", "out_capacity", "ring", "pipeline", "merge",
     ),
 )
 def summa3d_spgemm_windowed(
@@ -794,35 +937,57 @@ def summa3d_spgemm_windowed(
     panel_cap: int | None = None,
     piece_capacity: int,
     out_capacity: int,
+    ring: bool = False,
+    pipeline: bool = True,
+    merge: str = "sort",
 ) -> tuple[SpParMat3D, Array]:
     """C (col-split) = A (col-split) ⊗ B (row-split): the WINDOWED 3D
     SUMMA — ``Mult_AnXBn_SUMMA3D`` with the sort-free windowed local
     kernel in place of the per-stage ESC expand.
 
     Each layer runs the per-device windowed accumulate+extract core of
-    the 2D tier (``spgemm._windowed_gathered_compute`` — both backends,
-    duplicate-safe ``densify_combine``, packed launch list, per-window
-    symbolic caps sized by ``windowed_plan3d`` over the layer slices),
-    producing one sparse [tile_rows × tile_cols] partial per layer; the
-    L partials then ride the fiber ``all_to_all`` (``_fiber_exchange``)
-    and a compacting merge, exactly like the ESC 3D kernel.  The payoff
-    mirrors the reference's 3DSpGEMM: per-layer stage operands carry
-    1/L of the contraction, so per-stage gather volume shrinks L-fold
-    where the 2D carousel saturates.
+    the 2D tier — ``spgemm._windowed_gathered_compute`` (default), or
+    with ``ring=True`` the STAGE-PIPELINED CAROUSEL
+    (``spgemm._windowed_carousel_compute``): operands rotate
+    neighbor-to-neighbor in two-slot buffers on the within-layer joint
+    (row, col) axis, O(2·tile) sparse operand memory instead of
+    O(p·tile), and with ``pipeline=True`` stage s+1's ppermute issued
+    before stage s's accumulate (``pipeline=False`` pins the
+    rotate→compute→rotate serial chain via optimization_barrier — the
+    A/B measurement control).  Both backends, duplicate-safe
+    ``densify_combine``, packed launch list, per-window symbolic caps
+    sized by ``windowed_plan3d`` over the layer slices, identical chunk
+    layouts across schedules.  Each layer produces one sparse
+    [tile_rows × tile_cols] partial; the L partials ride the fiber
+    ``all_to_all`` (``_fiber_exchange``) and the ``merge``-selected
+    combine tier (``_fiber_merge``).  With the scatter / 1D-dot
+    backends the partial is already globally (row, col)-sorted
+    (ascending row blocks of sorted extractions), so ``merge="runs"``
+    eliminates the fiber reduce's sort ENTIRELY; the dot2d chunk order
+    is window-major within a block, so its pieces pre-sort on the
+    exchange side.  The payoff mirrors the reference's 3DSpGEMM:
+    per-layer stage operands carry 1/L of the contraction, so
+    per-stage gather volume shrinks L-fold where the 2D carousel
+    saturates.
 
-    Returns (C, overflow): max over devices of (extraction overflow,
-    fiber piece drop, merge distinct-keys − out_capacity) — zero means
-    exact (and with symbolic caps the first two are structurally ≤ 0).
+    Returns ``(C, overflow[4])``: per-device max of (extraction
+    overflow, fiber piece drop, merge distinct-keys − out_capacity,
+    hash placement overflow) — all ≤ 0 means exact (with symbolic caps
+    the first two are structurally ≤ 0); a positive hash overflow
+    means the CALLER must rerun through a sorted tier
+    (``spgemm3d_windowed`` does this automatically).
     """
     from .spgemm import (
         _PALLAS_KINDS,
         _gather_stage_tiles,
+        _windowed_carousel_compute,
         _windowed_gathered_compute,
     )
     from ..ops.spgemm import scatter_combine_for
 
     assert A3.split == "col" and B3.split == "row"
     assert A3.grid == B3.grid and A3.ncols == B3.nrows
+    assert merge in MERGE_TIERS, merge
     grid = A3.grid
     p = grid.pr
     assert grid.pr == grid.pc, "SUMMA3D requires square layer grids"
@@ -844,7 +1009,12 @@ def summa3d_spgemm_windowed(
         obs.count(
             "trace.summa3d_spgemm_windowed",
             backend=("dot2d" if two_d else backend),
+            ring=ring, merge=merge,
         )
+        if ring and pipeline and p > 1:
+            # trace-time: per-layer carousel stages whose successor
+            # rotation is issued early in this compiled program
+            obs.count("spgemm.pipeline.stages_overlapped", p - 1)
     zero = float(np.asarray(sr.zero_fn(A3.vals.dtype)))
     static = dict(
         lrA=lr, lrB=lrB, lcB=lcB, block_rows=block_rows,
@@ -853,32 +1023,44 @@ def summa3d_spgemm_windowed(
         interpret=interpret, block_cols=block_cols if two_d else None,
         panel_cap=panel_cap, zero=zero, dtype=A3.vals.dtype,
     )
+    # scatter / 1D-dot chunk layout: ascending row blocks, each chunk
+    # row-major-sorted by the windowed extraction → the concatenated
+    # partial's valid entries are globally (row, col)-sorted and the
+    # column-range piece selection preserves that; dot2d chunks are
+    # window-major within a block and need the exchange-side pre-sort
+    partial_sorted = not two_d
 
     def body(ar, ac, av, an, br, bc, bv, bn):
         a_mine = A3.local_tile(ar, ac, av, an)
         b_mine = B3.local_tile(br, bc, bv, bn)
-        a_stages = _gather_stage_tiles(a_mine, COL_AXIS, p)
-        b_stages = _gather_stage_tiles(b_mine, ROW_AXIS, p)
-        chunks, worst = _windowed_gathered_compute(
-            sr, a_stages, b_stages, **static
-        )
+        if ring:
+            chunks, worst = _windowed_carousel_compute(
+                sr, a_mine, b_mine, p=p, pipeline=pipeline, **static
+            )
+        else:
+            a_stages = _gather_stage_tiles(a_mine, COL_AXIS, p)
+            b_stages = _gather_stage_tiles(b_mine, ROW_AXIS, p)
+            chunks, worst = _windowed_gathered_compute(
+                sr, a_stages, b_stages, **static
+            )
         if not chunks:  # every window skipped on this layer
             chunks.append(SpTuples.empty(lr, lcB, 1, A3.vals.dtype))
         partial_c = SpTuples.concat(chunks)
-        merged, piece_over = _fiber_exchange(
-            partial_c, L, w_out, piece_capacity
+        runs, piece_over = _fiber_exchange(
+            partial_c, L, w_out, piece_capacity,
+            sort_pieces=(merge == "runs" and not partial_sorted),
         )
-        out, distinct = merged.compact_counted(sr, capacity=out_capacity)
-        worst = jnp.maximum(
-            jnp.maximum(worst, piece_over), distinct - out_capacity
+        out, merge_over, hash_over = _fiber_merge(
+            sr, runs, out_capacity, merge
         )
-        worst = lax.pmax(
-            lax.pmax(lax.pmax(worst, ROW_AXIS), COL_AXIS), LAYER_AXIS
+        overflow = jnp.stack([worst, piece_over, merge_over, hash_over])
+        overflow = lax.pmax(
+            lax.pmax(lax.pmax(overflow, ROW_AXIS), COL_AXIS), LAYER_AXIS
         )
         return (
             out.rows[None, None, None], out.cols[None, None, None],
             out.vals[None, None, None], out.nnz[None, None, None],
-            worst[None, None, None],
+            overflow[None, None, None],
         )
 
     r, c, v, n, overflow = jax.shard_map(
@@ -926,13 +1108,31 @@ def spgemm3d_windowed(
     mode: str = "f32",
     slack: float = 1.02,
     interpret: bool = False,
+    merge: str | None = None,
+    ring: bool = False,
+    pipeline: bool = True,
+    merge_source: str | None = None,
 ) -> SpParMat3D:
     """Sized entry for the windowed 3D tier: 3D symbolic pass →
     ``windowed_plan3d`` (caps maxed over layers) → the compiled
     ``summa3d_spgemm_windowed``.  Both accumulate backends; benchmarks
     on readback-poisoned hardware size on host via
     ``summa3d_window_flops_host`` + ``summa3d_window_bnnz_host`` and
-    call the kernel directly."""
+    call the kernel directly.
+
+    ``merge`` picks the fiber-reduce combine tier (``MERGE_TIERS``;
+    ``None`` resolves env ``COMBBLAS_SPGEMM_MERGE`` > the L/collision
+    heuristic — callers with a plan record pass its merge explicitly,
+    holding the arg > store > env > heuristic chain).  ``ring``/
+    ``pipeline`` pick the per-layer SUMMA schedule (the r9 carousel).
+    A hash-tier placement overflow is COUNTED
+    (``spgemm.merge.hash_overflow``) and the product transparently
+    reruns through the sorted-runs tier — never wrong, only slower.
+    A fiber piece overflow raises a diagnostic naming the ``slack``
+    knob instead of truncating downstream.  ``merge_source`` labels
+    the ``spgemm.merge.tier`` counter when a ROUTER (``spgemm3d``)
+    already resolved ``merge`` from its store/env rung — direct
+    callers leave it None ("arg")."""
     from .spgemm import (
         WINDOWED_CHUNK_W,
         default_block_cols,
@@ -943,6 +1143,7 @@ def spgemm3d_windowed(
         panel_cap_from_bnnz,
         resolve_spgemm_backend,
     )
+    from ..tuner import config as tuner_config
 
     backend = resolve_spgemm_backend(backend)
     grid = A3.grid
@@ -969,6 +1170,7 @@ def spgemm3d_windowed(
         npk = len(packed_windows_2d(skip))
         ntot = sum(len(row) for row in skip)
         per_block_bound = [sum(row) for row in out_caps]
+        pieces_sorted = False  # dot2d chunk order is window-major
     else:
         # scatter: the window pass with ONE full-width window gives the
         # per-block (padded, true) pair in one kernel
@@ -986,12 +1188,7 @@ def spgemm3d_windowed(
         npk = len(packed_windows(skip))
         ntot = len(skip)
         per_block_bound = list(out_caps)
-    if obs.ENABLED:
-        obs.gauge("spgemm.summa3d.layers", L)
-        obs.count("spgemm.windowed.windows_packed", npk)
-        obs.gauge(
-            "spgemm.windowed.pack_ratio", npk / ntot if ntot else 0.0
-        )
+        pieces_sorted = True
     # fiber piece / merge capacities from the same symbolic bounds: one
     # outgoing piece can hold at most the tile's whole extracted
     # partial; the merge receives L pieces and compacts to at most the
@@ -999,18 +1196,81 @@ def spgemm3d_windowed(
     rnd = lambda x: 1 << (max(int(x), 1) - 1).bit_length()
     piece_cap = rnd(min(sum(per_block_bound), lr * lcB))
     out_cap = min(rnd(piece_cap * L), max(lr * (lcB // L), 1))
+    if merge is not None and merge_source is None:
+        merge_source = "arg"
+    if merge is None:
+        merge = tuner_config.env_merge()
+        merge_source = "env" if merge is not None else None
+    if merge is None:
+        # collision estimate: total merge-input slots over the
+        # distinct-key bound — ≈ how many partial entries fold into
+        # each output key across the fiber
+        merge = _merge_heuristic(
+            sr, L, piece_cap * L / max(out_cap, 1), pieces_sorted
+        )
+        merge_source = "heuristic"
+    assert merge in MERGE_TIERS, merge
+    if obs.ENABLED:
+        obs.gauge("spgemm.summa3d.layers", L)
+        obs.count("spgemm.windowed.windows_packed", npk)
+        obs.gauge(
+            "spgemm.windowed.pack_ratio", npk / ntot if ntot else 0.0
+        )
+        obs.count(
+            "spgemm.merge.tier", tier=merge, source=merge_source,
+            op="spgemm3d",
+        )
     C, overflow = summa3d_spgemm_windowed(
         sr, A3, B3, block_rows=block_rows, flop_caps=flop_caps,
         out_caps=out_caps, skip=skip, backend=backend, mode=mode,
         chunk_w=chunk_w, interpret=interpret, block_cols=block_cols,
         panel_cap=panel_cap, piece_capacity=piece_cap,
-        out_capacity=out_cap,
+        out_capacity=out_cap, ring=ring, pipeline=pipeline,
+        merge=merge,
     )
-    over = int(np.asarray(host_value(overflow)))
-    assert over <= 0, (
-        f"windowed 3D tier overflowed its symbolic bound by {over}"
+    extract_over, piece_over, merge_over, hash_over = (
+        int(x) for x in np.asarray(host_value(overflow))
+    )
+    _check_fiber_overflow(piece_over, piece_cap, "spgemm3d_windowed",
+                          slack)
+    if hash_over > 0:
+        # counted fallback: the hash table failed to place hash_over
+        # entries — rerun through the sorted-runs tier (never wrong,
+        # only slower); the counter is how operators notice a
+        # mis-sized table / mis-routed plan
+        if obs.ENABLED:
+            obs.count("spgemm.merge.hash_overflow", hash_over)
+        return spgemm3d_windowed(
+            sr, A3, B3, block_rows=block_rows, block_cols=block_cols,
+            backend=backend, mode=mode, slack=slack,
+            interpret=interpret, merge="runs", ring=ring,
+            pipeline=pipeline, merge_source="hash_fallback",
+        )
+    assert extract_over <= 0 and merge_over <= 0, (
+        f"windowed 3D tier overflowed its symbolic bound "
+        f"(extraction {extract_over}, merge {merge_over})"
     )
     return C
+
+
+def _check_fiber_overflow(piece_over: int, piece_cap: int, who: str,
+                          slack: float) -> None:
+    """Shared fiber piece-overflow diagnostic: the exchange DETECTED
+    dropped entries (round-13 satellite — before this the count was
+    returned and silently ignored by some callers, truncating the
+    product downstream).  Counted as ``spgemm.summa3d.piece_overflow``
+    and raised with the knob that fixes it."""
+    if piece_over <= 0:
+        return
+    if obs.ENABLED:
+        obs.count("spgemm.summa3d.piece_overflow", piece_over)
+    raise ValueError(
+        f"{who}: fiber exchange overflowed — a piece exceeded its "
+        f"piece_capacity={piece_cap} by {piece_over} entries and the "
+        f"all_to_all would have dropped them; raise the sizing slack "
+        f"(slack={slack} at this call; spgemm3d(..., slack=) / "
+        f"{who}(..., slack=)) or pass a larger explicit piece capacity"
+    )
 
 
 def spgemm3d(
@@ -1018,6 +1278,8 @@ def spgemm3d(
     *, tier: str | None = None, backend: str | None = None,
     mode: str = "f32", block_rows: int | None = None,
     block_cols: int | None = None, interpret: bool = False,
+    merge: str | None = None, ring: bool | None = None,
+    pipeline: bool | None = None, merge_source: str | None = None,
 ) -> SpParMat3D:
     """Unjitted entry: distributed symbolic sizing → compiled 3D SUMMA.
 
@@ -1026,29 +1288,43 @@ def spgemm3d(
     semiring) or ``"windowed"`` (the sort-free dense-window tier,
     ``spgemm3d_windowed``).  Resolution follows the tuner precedence
     (tuner/config.py): argument > plan store (``op="spgemm3d"``
-    records, written by benches/operators — the 3D entry has no probe
-    pass yet) > env ``COMBBLAS_SPGEMM3D_TIER`` > ``"esc"``.  The ESC
-    sizing pass mirrors ``EstPerProcessNnzSUMMA``'s role
-    (ParFriends.h:1243); capacities round to powers of two (clamped to
-    the dense-tile bound) for compile-cache reuse.
+    records, written by benches/operators or the opt-in real-operand
+    probe) > env ``COMBBLAS_SPGEMM3D_TIER`` > probe
+    (``COMBBLAS_TUNER_PROBE=1``: ``tuner.probe.probe_spgemm3d``
+    measures admissible (tier, merge) pairs on the REAL operands and
+    persists the winner) > ``"esc"``.  The ESC sizing pass mirrors
+    ``EstPerProcessNnzSUMMA``'s role (ParFriends.h:1243); capacities
+    round to powers of two (clamped to the dense-tile bound) for
+    compile-cache reuse.
+
+    ``merge`` picks the fiber-reduce combine tier (``MERGE_TIERS``:
+    sort | runs | hash), resolved arg > store record > env
+    ``COMBBLAS_SPGEMM_MERGE`` > heuristic on L and the collision
+    estimate.  ``ring``/``pipeline`` are tri-state (None = defer to
+    the record, then the kernel defaults): the per-layer SUMMA's
+    carousel schedule.
     """
     from .. import obs
+    from ..ops.spgemm import scatter_combine_for
     from ..tuner import config as tuner_config
     from ..tuner import store as tuner_store
+    from ..tuner.resolve import resolve_merge
 
     plan_source = "arg" if tier is not None else None
+    st = rec = None
     if tier is None:
         st = tuner_store.get_store()
         # key construction costs host nnz readbacks (D2H syncs) — only
-        # pay it when the store actually holds plans (the 3D entry has
-        # no probe pass, so an empty store can never produce a hit)
-        if st is not None and st.entries() > 0:
-            rec = st.lookup(
-                tuner_store.spgemm3d_plan_key(
-                    sr, A, B,
-                    backend or tuner_config.env_backend() or "",
-                )
+        # pay it when the store holds plans OR the opt-in probe would
+        # persist one under the key (the axon D2H rule)
+        if st is not None and (
+            st.entries() > 0 or tuner_config.probe_enabled()
+        ):
+            key = tuner_store.spgemm3d_plan_key(
+                sr, A, B,
+                backend or tuner_config.env_backend() or "",
             )
+            rec = st.lookup(key) if st.entries() > 0 else None
             if rec is not None and rec.tier not in ("esc", "windowed"):
                 # a key-matched record with a non-3D tier is discarded
                 # — made visible, like the 2D router, so hits-vs-
@@ -1063,24 +1339,67 @@ def spgemm3d(
                     block_rows = rec.block_rows
                 if block_cols is None:
                     block_cols = rec.block_cols
+                # tri-state schedule flags: an explicit arg beats the
+                # record, None defers to it (the spgemm_auto contract)
+                if ring is None:
+                    ring = rec.ring
+                if pipeline is None:
+                    pipeline = rec.pipeline
     if tier is None:
         tier = tuner_config.env_tier3d()
         if tier is not None:
             plan_source = "env"
+    if tier is None and st is not None and tuner_config.probe_enabled():
+        from ..tuner.probe import probe_spgemm3d
+
+        prec = probe_spgemm3d(sr, A, B, store=st, key=key)
+        if prec is not None:
+            tier = prec.tier
+            plan_source = "probe"
+            rec = prec
+            if ring is None:
+                ring = prec.ring
+            if pipeline is None:
+                pipeline = prec.pipeline
     if tier is None:
         tier = "esc"
         plan_source = "heuristic"
+    # merge tier: arg > store record > env (heuristic resolves inside
+    # the sized entries, where the collision estimate exists).
+    # ``merge_source`` overrides the label when a CALLER already
+    # resolved merge (the hash-overflow rerun below).
+    caller_source = merge_source
+    merge, merge_source = resolve_merge(merge, rec)
+    if caller_source is not None:
+        merge_source = caller_source
+    elif merge_source == "store" and plan_source == "probe":
+        # the record came from this call's probe pass, not the store
+        merge_source = "probe"
     if obs.ENABLED:
         obs.count(
             "spgemm.auto.plan_source", source=plan_source, tier=tier,
             op="spgemm3d",
         )
     assert tier in ("esc", "windowed"), tier
+    ring = False if ring is None else bool(ring)
+    pipeline = True if pipeline is None else bool(pipeline)
     if tier == "windowed":
         return spgemm3d_windowed(
             sr, A, B, block_rows=block_rows, block_cols=block_cols,
             backend=backend, mode=mode, slack=max(slack - 0.03, 1.02),
-            interpret=interpret,
+            interpret=interpret, merge=merge, ring=ring,
+            pipeline=pipeline, merge_source=merge_source,
+        )
+    if ring and not pipeline:
+        # the ESC ring rides _carousel_stages, which is ALWAYS
+        # pipelined (PR 7 dropped its dead pipeline param: trace order
+        # alone is no serial control) — reject rather than mislabel a
+        # pipelined run as the serial A/B control (the windowed tier
+        # carries the real optimization_barrier control)
+        raise ValueError(
+            "spgemm3d: the esc tier's carousel has no serial "
+            "(pipeline=False) control — use tier='windowed' for the "
+            "pipelined-vs-serial A/B"
         )
     grid = A.grid
     L = grid.layers
@@ -1092,12 +1411,64 @@ def spgemm3d(
     dense_tile = A.tile_rows * (B.tile_cols // L)
     out_cap = max(min(int(total.max() * L * slack) + 1, dense_tile), 1)
     rnd = lambda x: 1 << (x - 1).bit_length()
-    return summa3d_spgemm(
-        sr, A, B,
-        flop_capacity=rnd(flop_cap),
-        out_capacity=min(rnd(out_cap), max(dense_tile, 1)),
-        piece_capacity=rnd(piece_cap),
+    piece_cap = rnd(piece_cap)
+    out_cap = min(rnd(out_cap), max(dense_tile, 1))
+    if merge is None:
+        # ESC stage chunks are UNSORTED (pieces_sorted=False): "runs"
+        # would pay L piece-local pre-sorts, so the heuristic keeps the
+        # one concat sort at low L and switches to hash only where the
+        # collision estimate says the O(nnz) table amortizes
+        merge = _merge_heuristic(
+            sr, L, piece_cap * L / max(out_cap, 1), pieces_sorted=False
+        )
+        merge_source = "heuristic"
+    if merge == "hash" and scatter_combine_for(sr) is None:
+        # a forced hash (env/record/arg) on a generic monoid must
+        # DEGRADE at the knob, not assert mid-trace inside the
+        # shard_map body — the 2D spgemm entry's convention; runs is
+        # exact for every semiring
+        merge = "runs"
+        merge_source = f"{merge_source}_degraded"
+    assert merge in MERGE_TIERS, merge
+    if obs.ENABLED:
+        obs.count(
+            "spgemm.merge.tier", tier=merge, source=merge_source,
+            op="spgemm3d",
+        )
+    def run_kernel(mg):
+        return summa3d_spgemm(
+            sr, A, B,
+            flop_capacity=rnd(flop_cap),
+            out_capacity=out_cap,
+            piece_capacity=piece_cap,
+            ring=ring, merge=mg,
+        )
+
+    C, overflow = run_kernel(merge)
+    piece_over, merge_over, hash_over = (
+        int(x) for x in np.asarray(host_value(overflow))
     )
+    _check_fiber_overflow(piece_over, piece_cap, "spgemm3d", slack)
+    if hash_over > 0:
+        # counted fallback: rerun the ALREADY-SIZED kernel through the
+        # sorted-runs tier (no re-entry into the routing entry — one
+        # logical call counts one plan_source resolution)
+        if obs.ENABLED:
+            obs.count("spgemm.merge.hash_overflow", hash_over)
+            obs.count(
+                "spgemm.merge.tier", tier="runs",
+                source="hash_fallback", op="spgemm3d",
+            )
+        C, overflow = run_kernel("runs")
+        piece_over, merge_over, _ = (
+            int(x) for x in np.asarray(host_value(overflow))
+        )
+        _check_fiber_overflow(piece_over, piece_cap, "spgemm3d", slack)
+    assert merge_over <= 0, (
+        f"spgemm3d: merge distinct keys exceeded out_capacity by "
+        f"{merge_over}; raise slack"
+    )
+    return C
 
 
 # --- 2D <-> 3D conversions (≈ SpParMat3D(SpParMat&) / layermat readback,

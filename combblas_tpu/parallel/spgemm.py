@@ -197,7 +197,8 @@ def _carousel_stages_pair(a_mine: SpTuples, x_mine, p: int, *,
 
 @partial(
     jax.jit,
-    static_argnames=("sr", "flop_capacity", "out_capacity", "ring"),
+    static_argnames=("sr", "flop_capacity", "out_capacity", "ring",
+                     "merge"),
 )
 def summa_spgemm(
     sr: Semiring,
@@ -207,23 +208,36 @@ def summa_spgemm(
     flop_capacity: int,
     out_capacity: int,
     ring: bool = False,
+    merge: str = "sort",
 ) -> SpParMat:
     """C = A ⊗ B over the grid.
 
     ``flop_capacity`` bounds ONE stage's expansion on one tile;
     ``out_capacity`` bounds the final per-tile nnz.
+
+    ``merge`` picks the stage-chunk combine (round 13): ``"sort"`` is
+    the classic concat + full ``lax.sort`` compact; ``"runs"`` sorts
+    each STAGE chunk individually (p sorts of flop_capacity — strictly
+    less sort work than one sort of p·flop_capacity) and k-way merges
+    the sorted runs by rank-space union
+    (``ops.spgemm.merge_sorted_runs``), so the compact skips its sort
+    entirely.  Bit-exact with ``"sort"`` for every semiring (ties keep
+    stage order).
     """
     _check_compat(A, B)
+    assert merge in ("sort", "runs"), merge
     grid = A.grid
     p = grid.pr
     if obs.ENABLED:
         # trace-time only (this fn is jitted): counts (re)traces per
         # static config, never executions — the jit retrace visibility
-        obs.count("trace.summa_spgemm", ring=ring)
+        obs.count("trace.summa_spgemm", ring=ring, merge=merge)
         if ring and p > 1:
             obs.count("spgemm.pipeline.stages_overlapped", p - 1)
 
     def body(ar, ac, av, an, br, bc, bv, bn):
+        from ..ops.spgemm import merge_sorted_runs
+
         # stitch local tiles
         a_mine = A.local_tile(ar, ac, av, an)
         b_mine = B.local_tile(br, bc, bv, bn)
@@ -249,8 +263,18 @@ def summa_spgemm(
             for s, a_cur, b_cur in _carousel_stages(a_mine, b_mine, p):
                 chunks.append(stage_output(a_cur, b_cur))
 
-        merged = SpTuples.concat(chunks)
-        out = merged.compact(sr, capacity=out_capacity)
+        if merge == "runs":
+            # per-stage sorts + rank-space union: the stage chunks ARE
+            # the sorted runs, so the compact skips its global sort
+            merged = merge_sorted_runs(
+                [ch.sort_rowmajor() for ch in chunks]
+            )
+            out = merged.compact(
+                sr, capacity=out_capacity, assume_sorted=True
+            )
+        else:
+            merged = SpTuples.concat(chunks)
+            out = merged.compact(sr, capacity=out_capacity)
         return SpParMat._pack_tile(out)
 
     r, c, v, n = jax.shard_map(
@@ -1574,6 +1598,8 @@ def spgemm(
     slack: float = 1.05,
     *,
     pow2_caps: bool = True,
+    merge: str | None = None,
+    merge_source: str | None = None,
 ) -> SpParMat:
     """Convenience: symbolic pass → sized numeric SUMMA (unjitted entry).
 
@@ -1583,8 +1609,33 @@ def spgemm(
     ``pow2_caps`` rounds both capacities up to powers of two (≤2× memory
     slack) so iterative callers (MCL's expand loop, BC's per-level products)
     hit the XLA compilation cache instead of recompiling for every new nnz.
+
+    ``merge``: the ESC stage-chunk combine (sort | runs) — ``None``
+    resolves env ``COMBBLAS_SPGEMM_MERGE`` > ``"sort"`` (the classic
+    path; ``spgemm_auto`` threads a plan record's remembered merge
+    through with ``merge_source="store"`` so the provenance counter
+    stays honest).  ``"hash"`` is a 3D-fiber tier; here it degrades
+    to ``"runs"`` (the expansion-sized chunks would swamp an
+    out-capacity table).
     """
+    from ..tuner import config as tuner_config
+
+    if merge is not None and merge_source is None:
+        merge_source = "arg"
+    if merge is None:
+        merge = tuner_config.env_merge()
+        merge_source = "env" if merge is not None else None
+    if merge == "hash":
+        merge = "runs"
+    if merge is None:
+        merge = "sort"
+        merge_source = "heuristic"
     with obs.span("spgemm", sr=sr.name):
+        if obs.ENABLED:
+            obs.count(
+                "spgemm.merge.tier", tier=merge, source=merge_source,
+                op="spgemm",
+            )
         flop_cap, out_cap = summa_capacities(A, B, slack)
         if pow2_caps:
             dense_tile = A.local_rows * B.local_cols
@@ -1595,7 +1646,8 @@ def spgemm(
                 "capacities", flop_capacity=flop_cap, out_capacity=out_cap
             )
         C = summa_spgemm(
-            sr, A, B, flop_capacity=flop_cap, out_capacity=out_cap
+            sr, A, B, flop_capacity=flop_cap, out_capacity=out_cap,
+            merge=merge,
         )
         _record_realized_nnz(C)
         return C
@@ -2896,6 +2948,7 @@ def spgemm_auto(
     ring: bool | None = None,
     pipeline: bool | None = None,
     dispatch: str | None = None,
+    merge: str | None = None,
 ) -> SpParMat:
     """Auto-tiered sparse-output SpGEMM: route (shape, density, semiring)
     through the fastest applicable kernel instead of defaulting to ESC.
@@ -2903,7 +2956,11 @@ def spgemm_auto(
     ``ring``/``pipeline`` are tri-state here (None = "let the resolved
     plan decide"): an EXPLICIT True/False always beats a remembered
     record's schedule flags — the arg > store precedence holds for
-    every knob, not just the tier.
+    every knob, not just the tier.  ``merge`` (sort | runs | hash) is
+    the combine-merge tier of the merge-consuming tiers (the esc
+    stage-chunk combine, the windowed3d fiber reduce), resolved the
+    same way: arg > record > env ``COMBBLAS_SPGEMM_MERGE`` >
+    per-entry heuristic.
 
     The ladder (see docs/spgemm.md and ``choose_spgemm_tier``):
 
@@ -2958,6 +3015,7 @@ def spgemm_auto(
     from ..tuner import store as tuner_store
 
     plan_source = "arg" if tier is not None else None
+    merge_source = "arg" if merge is not None else None
     store = key = rec = None
     if tier is None:
         # resolution precedence (documented once in tuner/config.py):
@@ -3014,6 +3072,11 @@ def spgemm_auto(
                 ring = rec.ring
             if pipeline is None:
                 pipeline = rec.pipeline
+            if merge is None and rec.merge is not None:
+                # provenance stays honest downstream: spgemm() /
+                # spgemm3d_windowed label the counter with THIS source
+                merge = rec.merge
+                merge_source = "store"
     # env geometry fills in AFTER the store record (precedence: a
     # measured plan's block shape beats a fleet-wide env default)
     if block_rows is None:
@@ -3057,7 +3120,8 @@ def spgemm_auto(
         )
     with obs.span("spgemm.auto", sr=sr.name, tier=tier):
         if tier == "esc":
-            return spgemm(sr, A, B, slack)
+            return spgemm(sr, A, B, slack, merge=merge,
+                          merge_source=merge_source)
         if tier == "scan":
             return spgemm_scan(
                 sr, A, B, out_capacity=out_capacity, slack=slack,
@@ -3081,13 +3145,14 @@ def spgemm_auto(
 
             A3 = SpParMat3D.from_spmat(A, grid3, split="col")
             B3 = SpParMat3D.from_spmat(B, grid3, split="row")
-            # oracle/ring/pipeline are 2D-schedule knobs: the 3D tier's
-            # per-layer SUMMA is the gathered schedule (a 3D carousel is
-            # an open ROADMAP item) and oracle seeding is 2D-plan-only
+            # ring/pipeline now reach the per-layer 3D SUMMA too (the
+            # round-13 carousel); oracle seeding stays 2D-plan-only
             C3 = spgemm3d_windowed(
                 sr, A3, B3, block_rows=block_rows,
                 block_cols=block_cols, backend=backend, mode=mode,
-                slack=slack, interpret=interpret,
+                slack=slack, interpret=interpret, merge=merge,
+                ring=ring, pipeline=pipeline,
+                merge_source=merge_source,
             )
             return C3.to_spmat(A.grid)
         # tier == "mxu": the round-4 whole-tile dense path

@@ -69,6 +69,17 @@ ENV_DYNAMIC_SPILL = "COMBBLAS_DYNAMIC_SPILL_FRAC"
 ENV_SPMM_BACKEND = "COMBBLAS_SPMM_BACKEND"
 ENV_DYNAMIC_HEADROOM = "COMBBLAS_DYNAMIC_HEADROOM"
 
+#: Round-13 knob: the SpGEMM combine-merge tier (sort | runs | hash) —
+#: how partial-product pieces (3D fiber pieces, 2D ESC stage chunks)
+#: fold into one compacted tile.  Resolution: arg > plan-store record
+#: > this env > the L/collision heuristic (docs/spgemm.md "merge
+#: tiers").
+ENV_MERGE = "COMBBLAS_SPGEMM_MERGE"
+
+#: Valid merge-tier names (parallel/mesh3d re-exports this as
+#: MERGE_TIERS — one definition, vetting and kernel asserts agree).
+MERGE_TIER_NAMES = ("sort", "runs", "hash")
+
 #: Default probe budget: total measured seconds across all candidate
 #: rungs for ONE store miss (compiles excluded from the budget check
 #: only insofar as the first candidate always completes).
@@ -194,6 +205,20 @@ def store_compact_min() -> int:
     load-time compaction rewrite (``tuner.store.compacted``)."""
     v = _int_env(ENV_STORE_COMPACT)
     return DEFAULT_STORE_COMPACT_MIN if v is None else v
+
+
+def env_merge() -> str | None:
+    """Fleet-wide SpGEMM merge-tier override (round 13).  A bogus
+    value raises here — naming the knob — instead of surfacing as a
+    bare kernel assert deep in a shard_map body (the round-12
+    SPMM_BACKEND vetting precedent)."""
+    v = _str_env(ENV_MERGE)
+    if v is not None and v not in MERGE_TIER_NAMES:
+        raise ValueError(
+            f"{ENV_MERGE} must be one of {'|'.join(MERGE_TIER_NAMES)}; "
+            f"got {v!r}"
+        )
+    return v
 
 
 def env_spmm_backend() -> str | None:

@@ -371,6 +371,117 @@ def _geometry_candidates(pm: int, pn: int) -> list[tuple]:
     return out[:4]
 
 
+def probe_spgemm3d(
+    sr,
+    A3,
+    B3,
+    *,
+    store: PlanStore | None = None,
+    key: PlanKey | None = None,
+    budget_s: float | None = None,
+    measure=None,
+    candidates=None,
+) -> PlanRecord | None:
+    """Measure admissible (tier, merge) pairs of the 3D entry ON THE
+    REAL OPERANDS and return / persist the winner — the op="spgemm3d"
+    micro-probe (round 13; before it the 3D entry had no probe pass
+    and store records could only be bench-seeded).
+
+    Like ``probe_spmm`` there is no downsampled proxy: a 3D probe run
+    is a warm run of a kernel the caller was about to run anyway, the
+    candidate list is small (≤ 5), and the pass is opt-in
+    (``COMBBLAS_TUNER_PROBE=1``) and budget-bounded with the
+    heuristic's own choice (esc + its default merge) measured FIRST,
+    so exhaustion still yields a measured plan.  The sweep covers the
+    merge knob — the fiber reduce's combine tier is exactly what the
+    CPU-mesh schedule measurement can rank (sort work is local) —
+    and persists the winner's ``merge`` in the plan record."""
+    import jax
+
+    from ..ops.spgemm import scatter_combine_for
+    from ..parallel import mesh3d
+
+    budget_s = config.probe_budget_s() if budget_s is None else budget_s
+
+    if candidates is None:
+        # heuristic first (esc with its own merge resolution), then the
+        # merge alternates, then the windowed tier with ITS heuristic
+        # merge + the sort control — ≤ 5 real-scale runs, each one a
+        # kernel the caller could legitimately route to
+        candidates = [("esc", None), ("esc", "runs")]
+        if scatter_combine_for(sr) is not None:
+            candidates += [
+                ("windowed", None), ("windowed", "sort"),
+            ]
+            if A3.grid.layers >= 2:
+                candidates.append(("windowed", "hash"))
+        # a fleet-wide COMBBLAS_SPGEMM_MERGE makes the None-merge
+        # candidates resolve to the env value — dedupe so the budget
+        # never times the IDENTICAL kernel twice (and noise never
+        # picks between two equal entries)
+        env_merge = config.env_merge()
+        if env_merge is not None:
+            seen, uniq = set(), []
+            for tier, mg in candidates:
+                eff = (tier, mg if mg is not None else env_merge)
+                if eff not in seen:
+                    seen.add(eff)
+                    uniq.append((tier, mg))
+            candidates = uniq
+
+    def _measure_default(fn) -> float:
+        t0 = time.perf_counter()
+        out = fn()
+        jax.block_until_ready(out.vals)
+        return time.perf_counter() - t0
+
+    measure = _measure_default if measure is None else measure
+    costs: dict[tuple, float] = {}
+    spent = 0.0
+    runs = 0
+    with obs.span(
+        "tuner.probe", sr=sr.name, dim=int(A3.nrows), op="spgemm3d"
+    ):
+        for tier, merge in candidates:
+            if costs and spent >= budget_s:
+                if obs.ENABLED:
+                    obs.count("tuner.probe.budget_exhausted")
+                break
+
+            def run(tier=tier, merge=merge):
+                return mesh3d.spgemm3d(sr, A3, B3, tier=tier,
+                                       merge=merge)
+
+            try:
+                run()  # compile + warm (untimed)
+                dt = float(measure(run))
+            except Exception:
+                if obs.ENABLED:
+                    obs.count("tuner.probe.errors", tier=tier)
+                continue
+            costs[(tier, merge)] = dt
+            spent += dt
+            runs += 1
+            if obs.ENABLED:
+                obs.count("tuner.probe.runs", tier=tier)
+    if store is not None:
+        store.record_probe(runs, spent)
+    if obs.ENABLED:
+        obs.count("tuner.probe.seconds", spent)
+    if not costs:
+        return None
+    winner = min(costs, key=costs.get)
+    if obs.ENABLED:
+        obs.count("tuner.probe.winner", tier=winner[0])
+    rec = PlanRecord(
+        tier=winner[0], merge=winner[1], cost_s=costs[winner],
+        source="probe", probe_dim=int(A3.nrows),
+    )
+    if store is not None and key is not None:
+        store.put(key, rec)
+    return rec
+
+
 def probe_spmm(
     sr,
     E,

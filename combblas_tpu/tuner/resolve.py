@@ -97,3 +97,25 @@ def resolve_tier(
             "spgemm.auto.plan_source", source=source, tier=tier, op=op,
         )
     return tier, source, rec
+
+
+def resolve_merge(merge: str | None, rec):
+    """Resolve the SpGEMM combine-merge tier through the top of the
+    chain: ``arg > store record > env COMBBLAS_SPGEMM_MERGE``.  Returns
+    ``(merge, source)`` — ``(None, None)`` when nothing above decided,
+    in which case the SIZED ENTRY runs the heuristic (it alone holds
+    the L / collision estimate the heuristic needs) and emits the
+    ``spgemm.merge.tier`` counter with the final source.
+
+    A record's merge field is vetted at store LOAD
+    (``PlanRecord.from_json``), so anything reaching here is a valid
+    tier name."""
+    if merge is not None:
+        assert merge in config.MERGE_TIER_NAMES, merge
+        return merge, "arg"
+    if rec is not None and rec.merge is not None:
+        return rec.merge, "store"
+    env_val = config.env_merge()
+    if env_val is not None:
+        return env_val, "env"
+    return None, None

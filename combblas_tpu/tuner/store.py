@@ -122,6 +122,10 @@ class PlanRecord:
     pipeline: bool = True
     dispatch: str | None = None
     mode: str | None = None
+    #: Combine-merge tier (round 13: sort | runs | hash); ``None``
+    #: means "whatever the entry's env/heuristic resolves" — pre-r13
+    #: lines load as None, so the field is schema-additive.
+    merge: str | None = None
     cost_s: float | None = None
     source: str = "probe"          # probe | manual | bench
     probe_dim: int | None = None   # proxy dimension the cost came from
@@ -146,6 +150,9 @@ class PlanRecord:
             # vetted at LOAD time so a schema-valid but hand-mangled
             # line is skipped as invalid, never asserted on at routing
             raise ValueError(f"unknown dispatch {disp!r}")
+        merge = d.get("merge")
+        if merge is not None and merge not in config.MERGE_TIER_NAMES:
+            raise ValueError(f"unknown merge tier {merge!r}")
         br = d.get("block_rows")
         bc = d.get("block_cols")
         return PlanRecord(
@@ -156,6 +163,7 @@ class PlanRecord:
             pipeline=bool(d.get("pipeline", True)),
             dispatch=d.get("dispatch"),
             mode=d.get("mode"),
+            merge=merge,
             cost_s=(
                 None if d.get("cost_s") is None else float(d["cost_s"])
             ),

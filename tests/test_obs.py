@@ -311,6 +311,42 @@ def test_round10_tuner_counters_gated(rng, tmp_path, monkeypatch):
         tstore._reset_for_tests()
 
 
+def test_round13_merge_counters_gated(rng):
+    """ISSUE 11 satellite: the round-13 merge-tier series —
+    ``spgemm.merge.tier`` and the ``merge``-labeled trace counter —
+    are emitted under obs and cost NOTHING when disabled (the
+    zero-cost gate extended to the merge tiers).  The heavier 3D
+    counters (hash_overflow, piece_overflow, 3D stages_overlapped)
+    are asserted by tests/test_spgemm_merge.py on the same
+    ``obs.ENABLED``-guarded code paths."""
+    from combblas_tpu import PLUS_TIMES
+    from combblas_tpu.parallel.grid import Grid
+    from combblas_tpu.parallel.spgemm import spgemm
+    from combblas_tpu.parallel.spmat import SpParMat
+
+    grid = Grid.make(1, 1)
+    m = 64
+    r = rng.integers(0, m, 300).astype(np.int64)
+    c = rng.integers(0, m, 300).astype(np.int64)
+    A = SpParMat.from_global_coo(
+        grid, r, c, np.ones(300, np.float32), m, m
+    )
+    assert not obs.ENABLED
+    spgemm(PLUS_TIMES, A, A, merge="runs")
+    assert obs.registry.empty()  # disabled: zero bookkeeping
+    assert obs._spans.empty()
+    obs.enable(install_hooks=False)
+    try:
+        obs.reset()
+        spgemm(PLUS_TIMES, A, A, merge="runs")
+        assert obs.registry.get_counter(
+            "spgemm.merge.tier", tier="runs", source="arg", op="spgemm"
+        ) == 1
+    finally:
+        obs.disable()
+        obs.reset()
+
+
 # --- JSONL round-trip + multihost merge -------------------------------------
 
 
