@@ -826,11 +826,18 @@ def serve_bench_main():
     the 8-virtual-device CPU mesh). The child emits its
     serve-throughput telemetry as a JSONL sidecar through the existing
     obs.enable_sidecar plumbing (BENCH_OBS defaults ON for this path;
-    the sidecar path rides the JSON line as "obs_jsonl")."""
+    the sidecar path rides the JSON line as "obs_jsonl").  The chaos /
+    mutate / pool scenario knobs (BENCH_SERVE_CHAOS, BENCH_SERVE_MUTATE,
+    BENCH_SERVE_POOL — the round-14 multi-tenant scenario emits its own
+    headline summary line too) pass through via the environment."""
     _virtual_mesh_bench_main(
         "serve_bench.py", "serve_throughput",
-        rc_of=lambda out: out.get("value", 0),
-        extra_env={"BENCH_OBS": "1"},
+        # every serve scenario reports its acceptance AND in "ok";
+        # falling back to value covers a crashed child's stub dict
+        rc_of=lambda out: out.get("ok", out.get("value", 0)),
+        # the child's detail line must stay LAST under this runner:
+        # the pool scenario's standalone summary line is suppressed
+        extra_env={"BENCH_OBS": "1", "BENCH_EMIT_SUMMARY": "0"},
     )
 
 

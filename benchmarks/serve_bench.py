@@ -46,6 +46,28 @@ merges incremental, and the counter-backed rebuild-amortization ratio
 Reports p99 read latency under writes, merge mode counts, and
 rows-patched/rebucketed counters.  ``BENCH_SERVE_MUTATE_WRITES`` sets
 the update-batch count (default 24).
+
+BENCH_SERVE_POOL=1 runs the MULTI-TENANT POOL scenario (ISSUE 12):
+``BENCH_POOL_TENANTS`` (default 4, the acceptance floor) tenant graphs
+behind one ``EnginePool``, three phases —
+
+  * WFQ fairness (deterministic, pump-driven): two saturated tenants
+    at weights 3:1 must serve within 25% of their weighted shares;
+  * mixed read/write load (threaded pool worker):
+    ``BENCH_SERVE_QUERIES`` (default 2000) weighted mixed-kind queries
+    across all tenants plus a ``BENCH_POOL_WRITES`` (default 16)
+    update stream into tenant t0, reporting throughput, p50/p99
+    latency, per-tenant rejects and occupancy/padding waste, gating
+    ZERO steady-state retraces across every tenant's plan cache;
+  * LRU eviction: the byte budget is tightened to half the resident
+    set, tenants are touched round-robin, and the gate asserts
+    resident device bytes STAY under the budget at every admit while
+    an evicted tenant re-admits BIT-EXACTLY (``to_host_coo``).
+
+Emits the standard ``{summary, metric, value, median, warning, rc}``
+final stdout line + BENCH_SUMMARY.json (with a per-tenant breakdown)
+itself, so a standalone run honors the bench headline contract;
+results are archived under benchmarks/results/r14/.
 """
 
 from __future__ import annotations
@@ -537,7 +559,318 @@ def run_mutate(scale: int = SCALE, edgefactor: int = EDGEFACTOR,
     return out
 
 
+def run_pool(scale: int = SCALE, edgefactor: int = EDGEFACTOR,
+             grid_shape=(2, 4), kinds=("bfs", "pagerank")) -> dict:
+    """BENCH_SERVE_POOL=1 — the multi-tenant pool scenario (ISSUE 12);
+    see the module docstring for the three phases and their gates."""
+    import threading
+
+    import numpy as np
+
+    from combblas_tpu import obs
+    from combblas_tpu.parallel.grid import Grid
+    from combblas_tpu.serve import (
+        BackpressureError, EnginePool, ServeConfig,
+    )
+    from combblas_tpu.utils.rmat import rmat_symmetric_coo_host
+
+    sidecar = obs.enable_sidecar("serve-pool")
+    ntenants = max(int(os.environ.get("BENCH_POOL_TENANTS", "4")), 2)
+    nqueries = int(os.environ.get("BENCH_SERVE_QUERIES", "2000"))
+    nwrites = int(os.environ.get("BENCH_POOL_WRITES", "16"))
+    widths = (1, 2, 4, 8, 16)
+    n = 1 << scale
+    grid = Grid.make(*grid_shape)
+
+    # tenants: independent graphs, weighted 3:1 for the first pair
+    # (the fairness phase's A/B), everyone else 1.0
+    weights = [3.0, 1.0] + [1.0] * (ntenants - 2)
+    cfg = ServeConfig(
+        lane_widths=widths, max_queue=4096, max_wait_s=0.005,
+        update_flush=4, update_max_delay_s=0.01,
+        update_autostart=False,  # the POOL worker merges (WFQ-charged)
+    )
+    pool = EnginePool(grid)
+    t0 = time.perf_counter()
+    tenant_rows = {}
+    for i in range(ntenants):
+        rows, cols = rmat_symmetric_coo_host(42 + i, scale, edgefactor)
+        name = f"t{i}"
+        tenant_rows[name] = rows
+        pool.add_tenant(
+            name, rows, cols, n, weight=weights[i], config=cfg,
+            kinds=kinds, keep_coo=(i == 0),
+        )
+    load_s = time.perf_counter() - t0
+    names = [f"t{i}" for i in range(ntenants)]
+
+    psrv = pool.serve()
+    t0 = time.perf_counter()
+    psrv.warmup()  # every tenant, every (kind, width) lane bucket
+    warmup_s = time.perf_counter() - t0
+    marks = {t: pool.engine(t).trace_mark() for t in names}
+
+    # -- phase 1: WFQ weighted share (deterministic, pump-driven) ----------
+    for _ in range(120):
+        psrv.submit("t0", "bfs", 1)
+        psrv.submit("t1", "bfs", 1)
+    served0 = dict(psrv.wfq.describe()["served"])
+    for _ in range(3):  # three DRR rounds, both queues stay saturated
+        psrv.pump(force=True)
+    served1 = psrv.wfq.describe()["served"]
+    share = {
+        t: served1.get(t, 0) - served0.get(t, 0) for t in ("t0", "t1")
+    }
+    fair_ratio = share["t0"] / max(share["t1"], 1)
+    fairness_ok = 0.75 * 3.0 <= fair_ratio <= 1.25 * 3.0
+    while psrv.pump(force=True):  # drain the saturation backlog
+        pass
+
+    # -- phase 2: mixed read/write load under the threaded worker ----------
+    rng = np.random.default_rng(7)
+    p = np.asarray(weights) / sum(weights)
+    roots_of = {}
+    for t in names:
+        deg = np.bincount(tenant_rows[t], minlength=n)
+        roots_of[t] = np.flatnonzero(deg > 0)
+    stream = [
+        (
+            names[int(rng.choice(ntenants, p=p))],
+            kinds[q % len(kinds)],
+        )
+        for q in range(nqueries)
+    ]
+    # churn pairs whose endpoint degrees sit in slack ladder classes
+    # (the run_mutate recipe): provably in-place merges, so the
+    # zero-retrace gate is a real plan-cache assertion under writes
+    deg0 = np.asarray(pool.engine("t0").version.deg)
+    slack = np.isin(deg0, (5, 7, 9, 10, 11, 13, 14, 15, 17, 18, 19))
+    pool_v = np.flatnonzero(slack).tolist()
+    r0, c0, _ = pool.engine("t0").version.host_coo
+    present = set(zip(r0.tolist(), c0.tolist()))
+    pairs = []
+    for a, b in zip(pool_v[0::2], pool_v[1::2]):
+        if (a, b) not in present:
+            pairs.append((a, b))
+        if len(pairs) >= max(nwrites, 1):
+            break
+
+    lat_of: dict = {}
+    rejects = {t: 0 for t in names}
+    write_futs = []
+    write_rejects = 0
+    t0 = time.perf_counter()
+    with psrv:
+
+        def writer():
+            nonlocal write_rejects
+            for k, (a, b) in enumerate(pairs + pairs):
+                op = "insert" if k < len(pairs) else "delete"
+                try:
+                    write_futs.append(psrv.submit_update(
+                        "t0", [(op, a, b), (op, b, a)]
+                    ))
+                except BackpressureError:
+                    write_rejects += 1
+                time.sleep(0.002)
+
+        wt = threading.Thread(target=writer)
+        wt.start()
+        futs = []
+        for tenant, kind in stream:
+            root = int(rng.choice(roots_of[tenant]))
+            ts = time.monotonic()
+            try:
+                f = psrv.submit(tenant, kind, root)
+            except BackpressureError:
+                rejects[tenant] += 1
+                continue
+            f.add_done_callback(
+                lambda _f, ts=ts, t=tenant: lat_of.setdefault(
+                    _f, (t, time.monotonic() - ts)
+                )
+            )
+            futs.append(f)
+        wt.join(120)
+        # wait(), not result(): a failed/expired request must be
+        # COUNTED, not crash the scenario before the summary line —
+        # and the stranded gate is only real when futures may still
+        # be pending at the check
+        from concurrent.futures import wait as _wait
+
+        _wait(futs + write_futs, timeout=600)
+        stats = psrv.stats()
+    wall_s = time.perf_counter() - t0
+    stranded = sum(
+        1 for f in futs + write_futs if not f.done()
+    )
+    read_errors = sum(
+        1 for f in futs
+        if f.done() and f.exception(timeout=0) is not None
+    )
+    write_errors = sum(
+        1 for f in write_futs
+        if f.done() and f.exception(timeout=0) is not None
+    )
+    retraces = {
+        t: pool.engine(t).retraces_since(marks[t]) for t in names
+    }
+    lat_by_t = {t: [] for t in names}
+    for t, dt in lat_of.values():
+        lat_by_t[t].append(dt)
+    lat_all = [dt for _t, dt in lat_of.values()]
+    merges = stats["servers"]["t0"]["updates"]["merges"]
+
+    # -- phase 3: LRU eviction under a tightened byte budget ---------------
+    sizes = {
+        t: pool.stats()["tenants"][t]["device_bytes"] for t in names
+    }
+    before_t1 = pool.engine("t1").version.E.to_host_coo()
+    pool.byte_budget = max(sum(sizes.values()) // 2, max(sizes.values()))
+    pool.refresh_bytes(names[-1])
+    under_budget = [pool.resident_bytes() <= pool.byte_budget]
+    for t in names:  # round-robin touches force evict/re-admit churn
+        pool.engine(t)
+        under_budget.append(
+            pool.resident_bytes() <= pool.byte_budget
+        )
+    after_t1 = pool.engine("t1").version.E.to_host_coo()
+    bit_exact = all(
+        np.array_equal(x, y) for x, y in zip(before_t1, after_t1)
+    )
+    pst = pool.stats()
+    evictions = {
+        t: pst["tenants"][t]["evictions"] for t in names
+    }
+    under_budget_ok = all(under_budget)
+
+    qps = len(futs) / wall_s if wall_s else 0.0
+    per_tenant = {
+        t: {
+            "weight": weights[i],
+            "queries": len(lat_by_t[t]),
+            "rejected": rejects[t],
+            "p99_ms": (
+                round(1e3 * _percentile(lat_by_t[t], 0.99), 2)
+                if lat_by_t[t] else None
+            ),
+            "mean_occupancy": stats["servers"][t].get("mean_occupancy"),
+            "retraces": retraces[t],
+            "evictions": evictions[t],
+            "admits": pst["tenants"][t]["admits"],
+            "device_bytes": sizes[t],
+        }
+        for i, t in enumerate(names)
+    }
+    padding_waste = None
+    if obs.ENABLED:
+        h = [
+            obs.registry.get_histogram(
+                "serve.batch.padding_waste", kind=k
+            )
+            for k in kinds
+        ]
+        tot = sum(x["count"] for x in h if x)
+        if tot:
+            padding_waste = round(
+                sum(x["sum"] for x in h if x) / tot, 3
+            )
+    ok = bool(
+        sum(retraces.values()) == 0
+        and fairness_ok
+        and under_budget_ok
+        and bit_exact
+        and stranded == 0
+        and read_errors == 0  # the stream is well-formed, no faults
+        and write_errors == 0
+        and merges >= 1
+        and sum(evictions.values()) >= 1
+    )
+    out = {
+        "metric": "serve_pool_throughput",
+        "unit": "queries/s",
+        "value": round(qps, 2),
+        "ok": ok,
+        "tenants": ntenants,
+        "nqueries": len(futs),
+        "p50_ms": (
+            round(1e3 * _percentile(lat_all, 0.50), 2)
+            if lat_all else None
+        ),
+        "p99_ms": (
+            round(1e3 * _percentile(lat_all, 0.99), 2)
+            if lat_all else None
+        ),
+        "padding_waste_mean_lanes": padding_waste,
+        "retraces_after_warmup": sum(retraces.values()),
+        "fair_share_ratio": round(fair_ratio, 2),
+        "fairness_ok": fairness_ok,
+        "wfq_shares_measured": share,
+        "update_merges": merges,
+        "write_rejects": write_rejects,
+        "stranded": stranded,
+        "read_errors": read_errors,
+        "write_errors": write_errors,
+        "byte_budget": pool.byte_budget,
+        "resident_bytes_final": pool.resident_bytes(),
+        "under_budget_ok": under_budget_ok,
+        "readmit_bit_exact": bit_exact,
+        "per_tenant": per_tenant,
+        "scale": scale,
+        "grid": list(grid_shape),
+        "kinds": list(kinds),
+        "load_s": round(load_s, 2),
+        "warmup_s": round(warmup_s, 2),
+        "wall_s": round(wall_s, 2),
+    }
+    obs.gauge("serve.bench.pool_qps", qps)
+    if sidecar:
+        try:
+            out["obs_jsonl"] = obs.dump_jsonl()
+        except Exception as e:  # telemetry must never fail the bench
+            out["obs_error"] = str(e)
+    return out
+
+
+def _emit_pool_summary(out: dict) -> int:
+    """The bench headline contract (bench.py ``emit_summary``) for the
+    standalone pool scenario: a compact truncation-proof final stdout
+    line + BENCH_SUMMARY.json carrying the per-tenant breakdown."""
+    rc = 0 if out.get("ok") else 1
+    s = {
+        "summary": 1,
+        "metric": out.get("metric"),
+        "value": out.get("value", 0.0),
+        "median": out.get("p50_ms", out.get("value", 0.0)),
+        "warning": out.get("warning"),
+        "rc": rc,
+        "per_tenant": out.get("per_tenant"),
+    }
+    path = os.environ.get("BENCH_SUMMARY_PATH", "BENCH_SUMMARY.json")
+    try:
+        with open(path, "w") as f:
+            json.dump(s, f)
+            f.write("\n")
+    except OSError as e:
+        s["summary_write_error"] = f"{path}: {e}"
+    print(json.dumps(s), flush=True)
+    return rc
+
+
 def main():
+    if os.environ.get("BENCH_SERVE_POOL") == "1":
+        out = run_pool()
+        print(json.dumps(out), flush=True)
+        if os.environ.get("BENCH_EMIT_SUMMARY", "1") != "0":
+            # STANDALONE contract: compact summary as the final line +
+            # BENCH_SUMMARY.json, gate failures as the exit code.
+            # Under bench.py's child runner (which sets
+            # BENCH_EMIT_SUMMARY=0) the DETAIL line must stay last and
+            # the exit code 0 — the parent parses the last line and
+            # derives rc itself; a nonzero child exit would discard
+            # the whole per-tenant payload as a "child crash".
+            sys.exit(_emit_pool_summary(out))
+        return
     if os.environ.get("BENCH_SERVE_CHAOS") == "1":
         out = run_chaos()
     elif os.environ.get("BENCH_SERVE_MUTATE") == "1":

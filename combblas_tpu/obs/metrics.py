@@ -430,6 +430,70 @@ name                                  kind     meaning
                                                label (2D ESC
                                                stage-chunk combine)
 ====================================  =======  =========================
+
+Multi-tenant pool / fleet series (round 14 — the engine pool, WFQ
+scheduling and the replicated serving fleet; docs/serving.md
+"Multi-tenant pool & fleet"):
+
+====================================  =======  =========================
+name                                  kind     meaning
+====================================  =======  =========================
+``serve.pool.resident_bytes``         gauge    device bytes of all
+                                               resident tenant
+                                               versions (the LRU's
+                                               accounting surface —
+                                               ``GraphVersion.
+                                               device_bytes``)
+``serve.pool.resident_tenants``       gauge    tenants whose engine is
+                                               currently on-device
+``serve.pool.admits``                 counter  engine builds/rebuilds
+                                               (label ``tenant``) —
+                                               re-admission after an
+                                               eviction counts here
+``serve.pool.evictions``              counter  device-state evictions
+                                               (label ``tenant``)
+``serve.pool.over_budget``            counter  admits that found no
+                                               idle victim and left
+                                               the pool over its byte
+                                               budget
+``serve.pool.rebuild_s``              hist     admit-time engine build
+                                               latency (the rebuild-
+                                               not-reload cost)
+``serve.wfq.rounds``                  counter  deficit-round-robin
+                                               scheduling rounds
+``serve.wfq.served``                  counter  requests/ops charged
+                                               per tenant (label
+                                               ``tenant``) — the
+                                               weighted-share property
+                                               is asserted on this
+``serve.wfq.deficit``                 gauge    per-tenant deficit
+                                               balance at round grant
+                                               (label ``tenant``)
+``serve.fleet.replicas``              gauge    replica count behind
+                                               the router
+``serve.fleet.submitted``             counter  queries routed (label
+                                               ``replica``)
+``serve.fleet.spillover``             counter  backpressure re-routes
+                                               to the next replica
+                                               (label ``replica`` =
+                                               the one that rejected)
+``serve.fleet.fanout``                counter  home-merge version
+                                               fan-outs applied fleet-
+                                               wide
+``serve.fleet.fanout_s``              hist     wall time of one full
+                                               fan-out (rebuilds +
+                                               atomic swaps)
+``serve.checkpoint.save_s``           hist     ``save_version``
+                                               snapshot wall time
+``serve.checkpoint.load_s``           hist     ``load_version``
+                                               restore wall time (one
+                                               device_put per array)
+====================================  =======  =========================
+
+Pre-existing serve series gain a ``tenant`` label when the emitting
+scheduler/breaker is owned by a pool tenant (``serve.queue.depth``,
+``serve.queue.rejected``, ``serve.requests``, ``serve.breaker.*``);
+single-tenant servers emit the unchanged label sets.
 """
 
 from __future__ import annotations

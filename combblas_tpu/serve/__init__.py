@@ -29,6 +29,17 @@ batches". Four layers (docs/serving.md has the full architecture):
    scripted/seeded/predicate rules so every recovery path (bisection,
    per-kind circuit breakers, worker backoff, swap rollback) is
    testable and chaos-benchable.
+6. **pool** (`pool.py`, round 14) — ``EnginePool``/``PoolServer``:
+   many resident tenant graphs behind one device — tenant → engine
+   routing, byte-accounted LRU eviction (host COO retained, re-admit
+   rebuilds bit-exact), per-tenant breakers/SLOs/fault injectors, and
+   one worker thread arbitrated by weighted deficit-round-robin
+   (reads AND write merges charge the tenant's share).
+7. **fleet** (`fleet.py`, round 14) — ``FleetRouter``: N replica
+   servers behind one front door sharing ONE warm plan store —
+   least-loaded routing with spillover, writes routed to a home
+   replica and fanned out through the atomic swap, warm starts from
+   ``utils.checkpoint.save_version`` GraphVersion snapshots.
 
 Everything is wired into ``combblas_tpu.obs`` (queue-depth gauge,
 occupancy/padding-waste/latency histograms, plan-cache and
@@ -43,14 +54,18 @@ from .scheduler import (
     BackpressureError,
     CircuitBreaker,
     CircuitBreakerOpen,
+    DeficitRoundRobin,
     Scheduler,
     ServeConfig,
 )
 from .api import Server
+from .pool import EnginePool, PoolServer
+from .fleet import FleetRouter
 
 __all__ = [
     "GraphEngine", "GraphVersion", "Server", "ServeConfig", "Scheduler",
     "BackpressureError", "CircuitBreaker", "CircuitBreakerOpen",
+    "DeficitRoundRobin", "EnginePool", "PoolServer", "FleetRouter",
     "FaultInjector", "InjectedFault", "FAULT_POINTS",
     "Request", "KINDS",
     "bucket_width", "assemble", "scatter",

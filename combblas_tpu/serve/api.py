@@ -46,11 +46,17 @@ from .scheduler import BackpressureError, Scheduler, ServeConfig, _bump
 class Server:
     """In-process query server over one ``GraphEngine``."""
 
-    def __init__(self, engine, config: ServeConfig | None = None):
+    def __init__(self, engine, config: ServeConfig | None = None,
+                 tenant: str | None = None):
         self.engine = engine
         self.config = config or ServeConfig()
+        #: Owning tenant (round 14, the multi-tenant pool): named in
+        #: backpressure errors, threaded through the scheduler's and
+        #: breakers' obs labels, and surfaced by stats()/health().
+        #: ``None`` (single-tenant) keeps every label set unchanged.
+        self.tenant = tenant
         self.scheduler = Scheduler(
-            self.config, engine.nrows, engine.kinds()
+            self.config, engine.nrows, engine.kinds(), tenant=tenant
         )
         # deterministic fault injection (serve/faults.py): unarmed by
         # default (one attribute read per check); chaos tests and the
@@ -249,7 +255,8 @@ class Server:
                 last = self._upd_buffer.add_many(ops)
             except DeltaOverflowError as e:
                 raise BackpressureError(
-                    self._upd_buffer.depth(), e.retry_after_s
+                    self._upd_buffer.depth(), e.retry_after_s,
+                    tenant=self.tenant,
                 ) from e
             except ValueError as e:
                 # malformed op: fail THIS future, poison nothing
@@ -647,6 +654,7 @@ class Server:
             for k in sch.kinds
         }
         s.update(
+            tenant=self.tenant,
             queue_depth=sch.depth(),
             submitted=sch.submitted,
             rejected=sch.rejected,
@@ -721,6 +729,7 @@ class Server:
             status = "ok"
         return {
             "status": status,
+            "tenant": self.tenant,
             "worker_alive": worker_alive,
             "closed": closed,
             "queue_depth": self.scheduler.depth(),

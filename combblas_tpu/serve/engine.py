@@ -108,6 +108,29 @@ class GraphVersion:
     #                                removed keys) — refresh lineage
     vid: int = 0                   # assigned when installed/swapped in
 
+    def device_bytes(self) -> int:
+        """Resident DEVICE bytes of this version: every uploaded array
+        a plan's operands can come from (the ELL matrices and their
+        twins, the feature table, the pagerank/dangling and lazy
+        degree vectors, the CSC companion).  The multi-tenant pool's
+        byte-accounted LRU evicts against this number
+        (``serve.pool.resident_bytes``); host-side state (COO, degree
+        tables, merge state) is deliberately NOT counted — eviction
+        frees the device, the host retains the rebuild inputs."""
+        total = 0
+        for M in (self.E, self.E_weighted, self.P_ell, self.ET):
+            if M is not None:
+                total += sum(
+                    int(a.nbytes) for b in M.buckets for a in b
+                )
+        for vec in (self.dangling, self.coldeg, self.invdeg, self.X):
+            blocks = getattr(vec, "blocks", None)
+            if blocks is not None:
+                total += int(blocks.nbytes)
+        if self.csc is not None:  # (indptr, rowidx) device pair
+            total += sum(int(a.nbytes) for a in self.csc)
+        return total
+
 
 def _build_version(grid, rows, cols, nrows: int, ncols: int,
                    weights, kinds: tuple[str, ...], symmetric: bool,
@@ -619,11 +642,11 @@ class GraphEngine:
             self._host_coo = None  # companion built: drop the edge list
         return self.csc
 
-    def serve(self, config=None):
+    def serve(self, config=None, tenant: str | None = None):
         from .api import Server
         from .scheduler import ServeConfig
 
-        return Server(self, config or ServeConfig())
+        return Server(self, config or ServeConfig(), tenant=tenant)
 
     # -- plan cache --------------------------------------------------------
 

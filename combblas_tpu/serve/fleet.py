@@ -1,0 +1,320 @@
+"""FleetRouter — N replica servers behind one front door (round 14).
+
+The horizontal half of the serving story: the pool multiplexes many
+GRAPHS behind one device; the fleet multiplexes many REPLICAS of one
+graph behind one router, the shape a real service scales reads with.
+Three properties make it more than a load balancer:
+
+* **One warm plan store.** Every replica resolves routing and records
+  serve warmup lanes through the SAME ``tuner.store`` JSONL (already
+  multi-process-safe, append-only, torn-write tolerant) — the first
+  replica's traffic teaches the store which (kind, width) lanes the mix
+  uses, and every later replica's ``warmup()`` replays them to
+  zero-retrace steady state without re-discovering anything
+  (docs/autotuning.md "Shipping plans to a fleet", now code).
+* **Warm starts from snapshots.** ``FleetRouter.from_checkpoint``
+  boots every replica from one ``utils.checkpoint.save_version``
+  GraphVersion snapshot: bucket arrays re-upload bit-identically
+  (``EllParMat.from_host_buckets`` — no dedup sort, no bucket pass), so
+  a cold replica reaches the same zero-retrace state as the donor
+  without ever seeing the COO.
+* **Writes route HOME, versions fan OUT.** ``submit_update`` goes to
+  one home replica (a single merge lineage — no cross-replica merge
+  conflicts to resolve); once its merge lands, ``fan_out`` rebuilds
+  each other replica's version OFF its execution lock from the home
+  version's retained host COO and applies it through the existing
+  atomic ``swap_graph`` — readers on every replica keep serving the old
+  version mid-build and flip in one pointer swap (incremental merges
+  preserve operand shapes, so the warm plans survive fleet-wide).
+
+Reads route to the least-loaded replica (queue depth, round-robin tie
+break) and SPILL OVER on backpressure: only when every replica rejects
+does the caller see the last ``BackpressureError``.
+
+Thread-hosted replicas: each ``Server`` owns its own engine, queue,
+breakers and worker thread inside this process — the honest analog of
+a replica fleet on the tier-1 virtual mesh, and exactly what one host
+of a multi-host fleet runs per chip.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from concurrent.futures import Future
+
+from .. import obs
+from .batcher import settle
+from .scheduler import BackpressureError, ServeConfig
+
+
+class FleetRouter:
+    """Front door over N replica ``Server``s sharing one plan store."""
+
+    def __init__(self, servers, home: int = 0, build_kw: dict | None = None):
+        if not servers:
+            raise ValueError("FleetRouter needs at least one replica")
+        self.replicas = list(servers)
+        if not (0 <= home < len(self.replicas)):
+            raise ValueError(
+                f"home replica {home} outside [0, {len(self.replicas)})"
+            )
+        #: Index of the replica all writes route to (one merge lineage).
+        self.home = home
+        #: ``build_version`` keywords fan-out rebuilds with (symmetric=
+        #: etc. — must match how the replicas were built).
+        self.build_kw = dict(build_kw or {})
+        # ONE execution stream across replicas: thread-hosted replicas
+        # share this process's device mesh, and two worker threads
+        # launching collective SPMD programs CONCURRENTLY interleave
+        # XLA's cross-module rendezvous (a hard deadlock, reproduced
+        # on the 8-virtual-device mesh) — so every replica engine's
+        # exec lock is replaced with one shared lock. A real fleet
+        # with per-replica devices runs replicas as separate
+        # processes; in-process, serialization is the device truth.
+        self._device_lock = threading.RLock()
+        for s in self.replicas:
+            s.engine._exec_lock = self._device_lock
+        self._rr = itertools.count()
+        self._fan_lock = threading.Lock()  # one fan-out at a time
+        self.submitted: list[int] = [0] * len(self.replicas)
+        self.spillovers = 0
+        self.fanouts = 0
+        obs.gauge("serve.fleet.replicas", len(self.replicas))
+
+    # -- construction ------------------------------------------------------
+
+    @staticmethod
+    def build(grid, rows, cols, nrows: int, *,
+              replicas: int | None = None,
+              config: ServeConfig | None = None,
+              home: int = 0, start: bool = True,
+              **from_coo_kw) -> "FleetRouter":
+        """Build N replicas from one COO (``COMBBLAS_FLEET_REPLICAS``
+        defaults the count). The home replica keeps the host edge list
+        (``keep_coo=True`` forced) — it feeds both the write lane and
+        the fan-out rebuilds."""
+        from .api import Server
+        from .engine import GraphEngine
+        from ..tuner import config as tuner_config
+
+        n = tuner_config.fleet_replicas(replicas)
+        servers = []
+        for i in range(n):
+            kw = dict(from_coo_kw)
+            if i == home:
+                kw["keep_coo"] = True
+            eng = GraphEngine.from_coo(grid, rows, cols, nrows, **kw)
+            servers.append(
+                Server(eng, config or ServeConfig(),
+                       tenant=f"replica{i}")
+            )
+        build_kw = {
+            k: from_coo_kw[k] for k in ("symmetric",)
+            if k in from_coo_kw
+        }
+        router = FleetRouter(servers, home=home, build_kw=build_kw)
+        if start:
+            for s in servers:
+                s.start()
+        return router
+
+    @staticmethod
+    def from_checkpoint(path: str, grid, *,
+                        replicas: int | None = None,
+                        config: ServeConfig | None = None,
+                        kinds=None, home: int = 0, start: bool = True,
+                        symmetric: bool = True) -> "FleetRouter":
+        """Boot N replicas from one ``save_version`` snapshot — the
+        cold-replica warm start: every replica's version re-uploads the
+        donor's exact bucket shapes (zero retraces once warmed; the
+        checkpoint round-trip regression test in
+        tests/test_serve_fleet.py pins this).  ``kinds=None`` derives
+        the servable kinds from the snapshot's artifacts."""
+        from .api import Server
+        from .engine import GraphEngine
+        from ..tuner import config as tuner_config
+        from ..utils import checkpoint
+
+        n = tuner_config.fleet_replicas(replicas)
+        servers = []
+        for i in range(n):
+            # one independent version per replica: engines swap and
+            # version-stamp independently, so sharing one GraphVersion
+            # object would cross-wire their lineages
+            v = checkpoint.load_version(path, grid)
+            eng = GraphEngine(grid, version=v, kinds=kinds)
+            servers.append(
+                Server(eng, config or ServeConfig(),
+                       tenant=f"replica{i}")
+            )
+        router = FleetRouter(
+            servers, home=home, build_kw={"symmetric": symmetric}
+        )
+        if start:
+            for s in servers:
+                s.start()
+        return router
+
+    # -- read path ---------------------------------------------------------
+
+    def _route_order(self) -> list[int]:
+        """Replica indices, least queue depth first; ties broken by a
+        rotating offset so equal-depth replicas share evenly."""
+        depths = [s.scheduler.depth() for s in self.replicas]
+        off = next(self._rr) % len(self.replicas)
+        return sorted(
+            range(len(self.replicas)),
+            key=lambda i: (depths[i], (i - off) % len(self.replicas)),
+        )
+
+    def submit(self, kind: str, root, timeout_s: float | None = None):
+        """Route one query to the least-loaded replica, spilling to
+        the next on backpressure/breaker rejection; raises the LAST
+        rejection only when every replica refused."""
+        last_exc: Exception | None = None
+        for i in self._route_order():
+            try:
+                fut = self.replicas[i].submit(
+                    kind, root, timeout_s=timeout_s
+                )
+            except BackpressureError as e:
+                self.spillovers += 1
+                obs.count("serve.fleet.spillover", replica=i)
+                last_exc = e
+                continue
+            self.submitted[i] += 1
+            obs.count("serve.fleet.submitted", replica=i)
+            return fut
+        raise last_exc  # every replica rejected
+
+    def submit_many(self, kind: str, roots,
+                    timeout_s: float | None = None) -> list:
+        """Bulk submit through the router. Unlike a single server's
+        prefix semantics, spillover means a LATER root can still land
+        after one was rejected fleet-wide — so each rejected root fails
+        its OWN future and admission continues."""
+        out = []
+        for r in roots:
+            try:
+                out.append(self.submit(kind, r, timeout_s=timeout_s))
+            except BackpressureError as e:
+                f: Future = Future()
+                f.set_exception(e)
+                out.append(f)
+        return out
+
+    # -- write path --------------------------------------------------------
+
+    def submit_update(self, ops, fan_out: bool = True):
+        """Route a mutation batch to the HOME replica; once its merge
+        lands, fan the new version out to every other replica through
+        the atomic swap. The returned future resolves (with the home
+        merge payload plus ``fanned_out``) after the whole fleet
+        serves the new version."""
+        home = self.replicas[self.home]
+        inner = home.submit_update(ops)
+        if not fan_out:
+            return inner
+        outer: Future = Future()
+
+        def _after_merge(f):
+            exc = f.exception()
+            if exc is not None:
+                settle(outer, exc=exc)
+                return
+            payload = dict(f.result())
+            try:
+                payload["fanned_out"] = self.fan_out()
+            except Exception as e:  # the home merge LANDED; a failed
+                # fan-out is a divergence the caller must see
+                settle(outer, exc=e)
+                return
+            settle(outer, result=payload)
+
+        inner.add_done_callback(_after_merge)
+        return outer
+
+    def fan_out(self) -> int:
+        """Propagate the home replica's CURRENT version to every other
+        replica: rebuild each replica's own version from the home
+        version's retained host COO (off that replica's execution
+        lock — its readers keep serving) and swap atomically. Returns
+        replicas updated."""
+        with self._fan_lock:
+            v = self.replicas[self.home].engine.version
+            if v.host_coo is None:
+                raise ValueError(
+                    "fan_out needs the home replica's host edge list: "
+                    "build the fleet via FleetRouter.build (or "
+                    "from_coo(keep_coo=True))"
+                )
+            rows, cols, _nc = v.host_coo
+            weights = v.host_weights
+            t0 = time.perf_counter()
+            n = 0
+            for i, srv in enumerate(self.replicas):
+                if i == self.home:
+                    continue
+                nv = srv.engine.build_version(
+                    rows, cols, weights=weights, keep_coo=False,
+                    **self.build_kw,
+                )
+                srv.swap_graph(nv)
+                n += 1
+            self.fanouts += 1
+            obs.count("serve.fleet.fanout")
+            obs.observe(
+                "serve.fleet.fanout_s", time.perf_counter() - t0
+            )
+            return n
+
+    # -- lifecycle / introspection -----------------------------------------
+
+    def warmup(self, **kw) -> dict:
+        """Warm every replica. With the shared plan store populated
+        (a prior replica's traffic), each replica pre-traces the
+        remembered lanes — the fleet-wide zero-retrace claim."""
+        return {
+            i: srv.warmup(**kw) for i, srv in enumerate(self.replicas)
+        }
+
+    def close(self, drain: bool = True, timeout: float = 30.0) -> None:
+        for srv in self.replicas:
+            srv.close(drain=drain, timeout=timeout)
+
+    def __enter__(self) -> "FleetRouter":
+        for srv in self.replicas:
+            srv.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def stats(self) -> dict:
+        return {
+            "replicas": len(self.replicas),
+            "home": self.home,
+            "routed": list(self.submitted),
+            "spillovers": self.spillovers,
+            "fanouts": self.fanouts,
+            "per_replica": {
+                i: srv.stats() for i, srv in enumerate(self.replicas)
+            },
+        }
+
+    def health(self) -> dict:
+        per = {i: srv.health() for i, srv in enumerate(self.replicas)}
+        statuses = {h["status"] for h in per.values()}
+        if statuses <= {"ok"}:
+            status = "ok"
+        elif "ok" in statuses or "degraded" in statuses:
+            status = "degraded"  # something still serves
+        else:
+            status = "down"
+        return {
+            "status": status,
+            "replicas": per,
+            "home": self.home,
+        }

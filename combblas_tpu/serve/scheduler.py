@@ -35,15 +35,21 @@ class BackpressureError(RuntimeError):
     """Queue full: the caller should back off and retry.
 
     ``retry_after_s`` is the server's hint — one flush deadline, i.e.
-    when capacity is next expected to free up.
+    when capacity is next expected to free up.  ``tenant`` (round 14)
+    NAMES the rejected tenant when the error came out of a
+    multi-tenant pool — a fleet client must know WHOSE budget it blew,
+    not just that some queue somewhere was full.
     """
 
-    def __init__(self, depth: int, retry_after_s: float):
+    def __init__(self, depth: int, retry_after_s: float,
+                 tenant: str | None = None):
+        who = f"tenant {tenant!r}: " if tenant else ""
         super().__init__(
-            f"serve queue full ({depth} pending); retry after "
+            f"{who}serve queue full ({depth} pending); retry after "
             f"{retry_after_s:.3f}s"
         )
         self.retry_after_s = retry_after_s
+        self.tenant = tenant
 
 
 class CircuitBreakerOpen(BackpressureError):
@@ -53,14 +59,17 @@ class CircuitBreakerOpen(BackpressureError):
     ``BackpressureError`` — retry-after semantics are identical, so
     callers with a backoff loop need no new handling."""
 
-    def __init__(self, kind: str, retry_after_s: float):
+    def __init__(self, kind: str, retry_after_s: float,
+                 tenant: str | None = None):
+        who = f"tenant {tenant!r}: " if tenant else ""
         RuntimeError.__init__(
             self,
-            f"circuit breaker open for kind {kind!r}; retry after "
+            f"{who}circuit breaker open for kind {kind!r}; retry after "
             f"{retry_after_s:.3f}s",
         )
         self.kind = kind
         self.retry_after_s = retry_after_s
+        self.tenant = tenant
 
 
 #: Circuit-breaker states (also the ``serve.breaker.state`` gauge
@@ -91,12 +100,18 @@ class CircuitBreaker:
     """
 
     def __init__(self, threshold: int = 5, cooldown_s: float = 1.0,
-                 cooldown_max_s: float = 30.0):
+                 cooldown_max_s: float = 30.0,
+                 tenant: str | None = None):
         if threshold < 1:
             raise ValueError("breaker threshold must be >= 1")
         self.threshold = int(threshold)
         self.cooldown_s = float(cooldown_s)
         self.cooldown_max_s = float(cooldown_max_s)
+        #: Owning tenant (round 14): rides every obs label this breaker
+        #: emits, so a pool dashboard separates tenants' breaker state.
+        #: ``None`` (the single-tenant default) adds no label — the
+        #: pre-pool series names are unchanged.
+        self.tenant = tenant
         self._lock = threading.Lock()
         self.state = BREAKER_CLOSED
         self.failures = 0  # consecutive, while CLOSED
@@ -105,6 +120,13 @@ class CircuitBreaker:
         self._probe_at: float | None = None  # half-open probe admitted
         self.opened_total = 0
         self.fast_fails = 0
+
+    def _lab(self, kind: str) -> dict:
+        """obs labels: ``kind`` always, ``tenant`` only when owned by a
+        pool tenant (single-tenant series stay label-compatible)."""
+        if self.tenant is None:
+            return {"kind": kind}
+        return {"kind": kind, "tenant": self.tenant}
 
     def admit(self, now: float, kind: str = "") -> bool:
         """May a submit of this kind be admitted right now? An OPEN
@@ -120,7 +142,8 @@ class CircuitBreaker:
                     self.state = BREAKER_HALF_OPEN
                     self._probe_at = now
                     obs.gauge("serve.breaker.state",
-                              _BREAKER_GAUGE[self.state], kind=kind)
+                              _BREAKER_GAUGE[self.state],
+                              **self._lab(kind))
                     return True
                 self.fast_fails += 1
                 return False
@@ -167,7 +190,7 @@ class CircuitBreaker:
                 closed_now = True
         if closed_now:  # gauge only on TRANSITION: the steady-state
             # healthy path (one record_success per batch) stays free
-            obs.gauge("serve.breaker.state", 0, kind=kind)
+            obs.gauge("serve.breaker.state", 0, **self._lab(kind))
 
     def record_failure(self, now: float, kind: str = "") -> None:
         opened = False  # did THIS call transition to OPEN?
@@ -193,9 +216,10 @@ class CircuitBreaker:
                 # refresh the clock, but it is NOT a new open transition
                 self.opened_at = now
             state = self.state
-        obs.gauge("serve.breaker.state", _BREAKER_GAUGE[state], kind=kind)
+        obs.gauge("serve.breaker.state", _BREAKER_GAUGE[state],
+                  **self._lab(kind))
         if opened:
-            obs.count("serve.breaker.opened", kind=kind)
+            obs.count("serve.breaker.opened", **self._lab(kind))
 
     def describe(self, now: float) -> dict:
         with self._lock:
@@ -264,6 +288,16 @@ class ServeConfig:
     update_flush: int = 64
     update_max_delay_s: float = 0.05
     update_autostart: bool = True
+    # -- per-tenant SLO admission (round 14; docs/serving.md
+    # "Multi-tenant pool & fleet").  ``slo_queue_budget`` rejects a
+    # submit once THIS scheduler holds that many pending requests
+    # (tighter than ``max_queue`` — the tenant's share of the pool, not
+    # the pool's physical bound); ``slo_deadline_s`` caps every
+    # admitted request's timeout at the tenant's deadline budget, so a
+    # request that cannot be served inside the SLO expires instead of
+    # occupying a lane late.  Both ``None`` (default) = disabled.
+    slo_queue_budget: int | None = None
+    slo_deadline_s: float | None = None
 
     def __post_init__(self):
         if (
@@ -293,6 +327,10 @@ class ServeConfig:
             )
         if self.update_max_delay_s <= 0:
             raise ValueError("update_max_delay_s must be > 0")
+        if self.slo_queue_budget is not None and self.slo_queue_budget < 1:
+            raise ValueError("slo_queue_budget must be >= 1")
+        if self.slo_deadline_s is not None and self.slo_deadline_s <= 0:
+            raise ValueError("slo_deadline_s must be > 0")
 
     def wait_for(self, kind: str) -> float:
         if self.per_kind_max_wait and kind in self.per_kind_max_wait:
@@ -304,10 +342,14 @@ class Scheduler:
     """Pending-request store with admission control and flush policy."""
 
     def __init__(self, config: ServeConfig, nrows: int,
-                 kinds: tuple[str, ...]):
+                 kinds: tuple[str, ...], tenant: str | None = None):
         self.config = config
         self.nrows = nrows
         self.kinds = kinds
+        #: Owning tenant (round 14): named in every backpressure error
+        #: and threaded through the obs labels below; ``None`` keeps
+        #: the single-tenant label sets unchanged.
+        self.tenant = tenant
         self._pending: dict[str, deque[Request]] = {
             k: deque() for k in kinds
         }
@@ -330,11 +372,19 @@ class Scheduler:
                     config.breaker_threshold,
                     config.breaker_cooldown_s,
                     config.breaker_cooldown_max_s,
+                    tenant=tenant,
                 )
                 for k in kinds
             }
             if config.breaker_threshold else {}
         )
+
+    def _lab(self, **labels) -> dict:
+        """obs labels with the tenant attached when one owns this
+        scheduler (see ``CircuitBreaker._lab``)."""
+        if self.tenant is not None:
+            labels["tenant"] = self.tenant
+        return labels
 
     def close(self) -> None:
         """Refuse all further admissions, PERMANENTLY (set under the
@@ -380,6 +430,12 @@ class Scheduler:
             timeout_s if timeout_s is not None
             else self.config.default_timeout_s
         )
+        slo = self.config.slo_deadline_s
+        if slo is not None:
+            # SLO deadline budget: a request may never outlive the
+            # tenant's deadline, whatever timeout it asked for — late
+            # answers are as bad as no answers under an SLO
+            timeout_s = slo if timeout_s is None else min(timeout_s, slo)
         deadline = None if timeout_s is None else now + timeout_s
         # error isolation: a bad root fails its OWN request, not a batch
         try:
@@ -394,7 +450,9 @@ class Scheduler:
             )
             with self._lock:
                 _bump(self.invalid_kind, kind)
-            obs.count("serve.requests", kind=kind, status="invalid")
+            obs.count(
+                "serve.requests", **self._lab(kind=kind, status="invalid")
+            )
             return fut
         breaker = self.breakers.get(kind)
         if breaker is not None and not breaker.admit(now, kind):
@@ -402,8 +460,10 @@ class Scheduler:
             # execution-health fact, not a queue-depth one
             with self._lock:
                 _bump(self.breaker_rejected_kind, kind)
-            obs.count("serve.breaker.fast_fail", kind=kind)
-            raise CircuitBreakerOpen(kind, breaker.retry_after(now))
+            obs.count("serve.breaker.fast_fail", **self._lab(kind=kind))
+            raise CircuitBreakerOpen(
+                kind, breaker.retry_after(now), tenant=self.tenant
+            )
         try:
             with self._lock:
                 if self._closed:  # re-check: close() may have raced
@@ -412,12 +472,17 @@ class Scheduler:
                         "serve.Server is closed; no further admissions"
                     )
                 d = sum(len(q) for q in self._pending.values())
-                if d >= self.config.max_queue:
+                budget = self.config.max_queue
+                if self.config.slo_queue_budget is not None:
+                    # the tenant's queue-depth budget: its share of the
+                    # pool, enforced tighter than the physical bound
+                    budget = min(budget, self.config.slo_queue_budget)
+                if d >= budget:
                     self.rejected += 1
                     _bump(self.rejected_kind, kind)
-                    obs.count("serve.queue.rejected", kind=kind)
+                    obs.count("serve.queue.rejected", **self._lab(kind=kind))
                     raise BackpressureError(
-                        d, self.config.wait_for(kind)
+                        d, self.config.wait_for(kind), tenant=self.tenant
                     )
                 req = Request(
                     rid=next(self._rid), kind=kind, root=root_i,
@@ -425,7 +490,7 @@ class Scheduler:
                 )
                 self._pending[kind].append(req)
                 self.submitted += 1
-                obs.gauge("serve.queue.depth", d + 1)
+                obs.gauge("serve.queue.depth", d + 1, **self._lab())
         except (BackpressureError, RuntimeError):
             if breaker is not None:
                 # this submit may have claimed the half-open probe
@@ -483,13 +548,20 @@ class Scheduler:
             )
 
     def pop_ready(self, now: float | None = None,
-                  force: bool = False) -> list[list[Request]]:
+                  force: bool = False,
+                  max_batches: int | None = None) -> list[list[Request]]:
         """Batches due for execution: a kind flushes when it can fill
         the widest lane bucket, when its oldest request has aged past
         the kind's flush deadline, or unconditionally under ``force``
         (drain/close). Expired requests are timed out here, before
         batching. Returns a list of per-kind request lists (each at most
         the widest bucket — a deep backlog flushes over several calls).
+
+        ``max_batches`` (round 14) bounds how many batches one call may
+        pop — the weighted-fair-queueing pump pops ONE batch per
+        deficit charge so a saturated tenant drains in weighted shares
+        instead of monopolizing the worker for its whole backlog; the
+        dead-request sweep still covers every kind regardless.
         """
         now = time.monotonic() if now is None else now
         wmax = self.config.lane_widths[-1]
@@ -513,8 +585,8 @@ class Scheduler:
                     for req in q:
                         if req.future.done():  # client cancel/settle
                             obs.count(
-                                "serve.requests", kind=kind,
-                                status="cancelled",
+                                "serve.requests",
+                                **self._lab(kind=kind, status="cancelled"),
                             )
                         elif req.expired(now):
                             timed_out.append(req)
@@ -525,11 +597,17 @@ class Scheduler:
                     or len(q) >= wmax
                     or now >= self._kind_deadline(kind, q)
                 ):
+                    if (
+                        max_batches is not None
+                        and len(out) >= max_batches
+                    ):
+                        break
                     take = min(len(q), wmax)
                     out.append([q.popleft() for _ in range(take)])
             obs.gauge(
                 "serve.queue.depth",
                 sum(len(q) for q in self._pending.values()),
+                **self._lab(),
             )
         if timed_out:
             with self._lock:
@@ -555,3 +633,129 @@ class Scheduler:
                     drained.append(q.popleft())
         for req in drained:
             settle(req.future, exc=exc)
+
+
+class DeficitRoundRobin:
+    """Weighted fair queueing across tenants (round 14): classic
+    deficit round robin over the tenants' own bounded queues.
+
+    Each scheduling ROUND grants every backlogged tenant
+    ``quantum x weight`` deficit credit and yields the tenants in
+    rotation order (the start position advances per round, so no
+    tenant enjoys a systematic first-mover advantage); the pump then
+    serves a tenant while its ``balance`` stays positive, CHARGING the
+    actual request count of each executed batch (post-charge: a batch
+    may overdraw the balance by at most one bucket width — the
+    overdraft carries into the next round, so long-run served shares
+    converge to the weights).  A tenant whose backlog EMPTIES has its
+    deficit reset (no banking: an idle tenant cannot hoard credit and
+    later burst past its weight — the textbook DRR rule).
+
+    Write-lane fairness rides the same meter: the pool pump charges a
+    tenant's merge cost (ops folded) against the same deficit, so a
+    mutation-heavy tenant spends its share on writes instead of
+    starving everyone else's reads.
+
+    Deterministic (no clocks, no randomness) and thread-safe; the obs
+    series are ``serve.wfq.rounds``, ``serve.wfq.served{tenant}`` and
+    ``serve.wfq.deficit{tenant}``.
+    """
+
+    def __init__(self, quantum: int | None = None):
+        from ..tuner import config as tuner_config
+
+        self.quantum = tuner_config.pool_quantum(quantum)
+        self._lock = threading.Lock()
+        self._weights: dict[str, float] = {}
+        self._deficit: dict[str, float] = {}
+        self._cursor = 0
+        self.rounds = 0
+        self.served: dict[str, int] = {}
+
+    def add(self, tenant: str, weight: float = 1.0) -> None:
+        if weight <= 0:
+            raise ValueError(
+                f"tenant {tenant!r} needs a positive WFQ weight, "
+                f"got {weight}"
+            )
+        with self._lock:
+            self._weights[tenant] = float(weight)
+            self._deficit.setdefault(tenant, 0.0)
+
+    def remove(self, tenant: str) -> None:
+        with self._lock:
+            self._weights.pop(tenant, None)
+            self._deficit.pop(tenant, None)
+            self.served.pop(tenant, None)
+
+    def prune(self, live) -> None:
+        """Drop every tenant NOT in ``live`` (the pool pump calls this
+        with the current tenant list): add/remove churn must not leak
+        weights/deficit/served entries — or their obs label space —
+        for dead tenant names forever."""
+        live = set(live)
+        with self._lock:
+            for t in [x for x in self._weights if x not in live]:
+                self._weights.pop(t, None)
+                self._deficit.pop(t, None)
+                self.served.pop(t, None)
+
+    def set_weight(self, tenant: str, weight: float) -> None:
+        self.add(tenant, weight)
+
+    def balance(self, tenant: str) -> float:
+        with self._lock:
+            return self._deficit.get(tenant, 0.0)
+
+    def round(self, backlogged) -> list[str]:
+        """One DRR round: grant ``quantum x weight`` to every
+        backlogged tenant, reset idle tenants' deficit, and return the
+        backlogged tenants in this round's rotation order."""
+        with self._lock:
+            names = list(self._weights)
+            live = {t for t in backlogged if t in self._weights}
+            for t in names:
+                if t in live:
+                    self._deficit[t] += self.quantum * self._weights[t]
+                else:
+                    self._deficit[t] = 0.0  # no banking while idle
+            if not names:
+                return []
+            start = self._cursor % len(names)
+            self._cursor += 1
+            order = [
+                t for t in names[start:] + names[:start] if t in live
+            ]
+            self.rounds += 1
+            # deficit SNAPSHOT under the lock: a concurrent remove()
+            # between release and the gauge loop must not KeyError
+            snap = {t: self._deficit[t] for t in order}
+        if obs.ENABLED:
+            obs.count("serve.wfq.rounds")
+            for t, v in snap.items():
+                obs.gauge("serve.wfq.deficit", v, tenant=t)
+        return order
+
+    def charge(self, tenant: str, cost: float) -> None:
+        """Spend ``cost`` (requests served or write ops merged) from
+        the tenant's balance — may overdraw (see class docstring)."""
+        with self._lock:
+            if tenant in self._deficit:
+                self._deficit[tenant] -= cost
+            self.served[tenant] = (
+                self.served.get(tenant, 0) + int(cost)
+            )
+        if obs.ENABLED:
+            obs.count("serve.wfq.served", cost, tenant=tenant)
+
+    def describe(self) -> dict:
+        with self._lock:
+            return {
+                "quantum": self.quantum,
+                "rounds": self.rounds,
+                "weights": dict(self._weights),
+                "deficit": {
+                    k: round(v, 3) for k, v in self._deficit.items()
+                },
+                "served": dict(self.served),
+            }

@@ -69,6 +69,18 @@ ENV_DYNAMIC_SPILL = "COMBBLAS_DYNAMIC_SPILL_FRAC"
 ENV_SPMM_BACKEND = "COMBBLAS_SPMM_BACKEND"
 ENV_DYNAMIC_HEADROOM = "COMBBLAS_DYNAMIC_HEADROOM"
 
+#: Round-14 knobs: the multi-tenant engine pool and the replicated
+#: serving fleet (docs/serving.md "Multi-tenant pool & fleet").
+#: ``COMBBLAS_POOL_BYTE_BUDGET`` bounds the pool's resident DEVICE
+#: bytes (LRU eviction past it; 0/unset = unbounded),
+#: ``COMBBLAS_POOL_QUANTUM`` is the weighted-fair-queueing deficit
+#: quantum (requests granted per round per unit weight), and
+#: ``COMBBLAS_FLEET_REPLICAS`` the default ``FleetRouter.build``
+#: replica count.
+ENV_POOL_BYTE_BUDGET = "COMBBLAS_POOL_BYTE_BUDGET"
+ENV_POOL_QUANTUM = "COMBBLAS_POOL_QUANTUM"
+ENV_FLEET_REPLICAS = "COMBBLAS_FLEET_REPLICAS"
+
 #: Round-13 knob: the SpGEMM combine-merge tier (sort | runs | hash) —
 #: how partial-product pieces (3D fiber pieces, 2D ESC stage chunks)
 #: fold into one compacted tile.  Resolution: arg > plan-store record
@@ -96,6 +108,12 @@ DEFAULT_DYNAMIC_SPILL_FRAC = 0.10
 #: Default bucket-slot headroom: none (static graphs pay no padding
 #: tax; dynamic engines opt in via from_coo(headroom=) or the env).
 DEFAULT_DYNAMIC_HEADROOM = 0.0
+#: Pool defaults (round 14): unbounded resident bytes (an operator
+#: opts into eviction by setting a budget) and a 16-request WFQ
+#: quantum per unit weight per round.
+DEFAULT_POOL_BYTE_BUDGET = 0
+DEFAULT_POOL_QUANTUM = 16
+DEFAULT_FLEET_REPLICAS = 2
 
 
 def _str_env(name: str) -> str | None:
@@ -235,6 +253,34 @@ def dynamic_headroom(given: float | None = None) -> float:
         return max(float(given), 0.0)
     v = os.environ.get(ENV_DYNAMIC_HEADROOM)
     return max(float(v), 0.0) if v else DEFAULT_DYNAMIC_HEADROOM
+
+
+def pool_byte_budget(given: int | None = None) -> int:
+    """Resident-device-byte budget of a serve ``EnginePool``: explicit
+    argument > ``COMBBLAS_POOL_BYTE_BUDGET`` > unbounded.  0 (and the
+    usual unset/empty) means UNBOUNDED — eviction is opt-in."""
+    if given is not None:
+        return max(int(given), 0)
+    v = _int_env(ENV_POOL_BYTE_BUDGET)
+    return DEFAULT_POOL_BYTE_BUDGET if v is None else max(v, 0)
+
+
+def pool_quantum(given: int | None = None) -> int:
+    """Weighted-fair-queueing deficit quantum (requests per round per
+    unit weight): explicit argument > ``COMBBLAS_POOL_QUANTUM`` > 16."""
+    if given is not None:
+        return max(int(given), 1)
+    v = _int_env(ENV_POOL_QUANTUM)
+    return DEFAULT_POOL_QUANTUM if v is None else max(v, 1)
+
+
+def fleet_replicas(given: int | None = None) -> int:
+    """Default ``FleetRouter.build`` replica count: explicit argument >
+    ``COMBBLAS_FLEET_REPLICAS`` > 2."""
+    if given is not None:
+        return max(int(given), 1)
+    v = _int_env(ENV_FLEET_REPLICAS)
+    return DEFAULT_FLEET_REPLICAS if v is None else max(v, 1)
 
 
 def dynamic_spill_frac() -> float:

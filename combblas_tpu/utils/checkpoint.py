@@ -162,6 +162,186 @@ def _npz_to_tuples(z, meta):
     return np.concatenate(rs), np.concatenate(cs), np.concatenate(vs)
 
 
+# --- GraphVersion snapshots (round 14 — the serving fleet's warm start) ----
+
+#: Schema tag of ``save_version`` snapshots; a mismatched tag is
+#: refused at load (never guessed at — the plan-store convention).
+VERSION_SCHEMA = "combblas_tpu.graph_version/v1"
+
+#: The EllParMat fields of a GraphVersion, in a fixed serialization
+#: order (absent twins are recorded as null bucket counts).
+_VERSION_MATS = ("E", "E_weighted", "P_ell", "ET")
+
+
+def save_version(path: str, version) -> None:
+    """Snapshot a serve ``GraphVersion`` to one self-describing .npz —
+    the warm-start half of the replicated fleet (docs/serving.md
+    "Multi-tenant pool & fleet").
+
+    What makes this different from re-running ``from_coo`` on the
+    replica: the BUCKET ARRAYS are persisted exactly as built —
+    per-class cols/vals/rowids including the headroom-resolved padding
+    rows — so ``load_version`` re-uploads bit-identical shapes with
+    ``EllParMat.from_host_buckets`` (one ``device_put`` per array, no
+    dedup sort, no host bucket pass) and a warmed plan cache keeps
+    every compiled executable: ZERO retraces after ``swap()``, the
+    regression-tested guarantee.  The host COO/weights ride along when
+    the version retained them (``keep_coo=True``), so a restored
+    replica can still serve the write lane.
+    """
+    import time
+
+    from .. import obs
+
+    t0 = time.perf_counter()
+    meta = {
+        "kind": "GraphVersion",
+        "v": VERSION_SCHEMA,
+        "nrows": int(version.nrows),
+        "ncols": int(version.ncols),
+        "nnz": int(version.nnz),
+        "feat_dim": int(version.feat_dim),
+        "headroom": version.headroom,
+        "grid": [version.E.grid.pr, version.E.grid.pc],
+        "mats": {},
+    }
+    arrays: dict = {
+        "deg": np.asarray(version.deg),
+    }
+    if version.outdeg is not None:
+        arrays["outdeg"] = np.asarray(version.outdeg)
+    for nm in _VERSION_MATS:
+        M = getattr(version, nm)
+        if M is None:
+            meta["mats"][nm] = None
+            continue
+        meta["mats"][nm] = {
+            "nbuckets": len(M.buckets),
+            "nrows": int(M.nrows),
+            "ncols": int(M.ncols),
+        }
+        for i, (bc, bv, br) in enumerate(M.buckets):
+            arrays[f"{nm}.{i}.c"] = np.asarray(jax.device_get(bc))
+            arrays[f"{nm}.{i}.v"] = np.asarray(jax.device_get(bv))
+            arrays[f"{nm}.{i}.r"] = np.asarray(jax.device_get(br))
+    if version.dangling is not None:
+        arrays["dangling"] = np.asarray(
+            jax.device_get(version.dangling.blocks)
+        )
+    if version.X is not None:
+        arrays["X"] = np.asarray(jax.device_get(version.X.blocks))
+    if version.host_coo is not None:
+        rows, cols, _nc = version.host_coo
+        arrays["coo_rows"] = np.asarray(rows)
+        arrays["coo_cols"] = np.asarray(cols)
+        if version.host_weights is not None:
+            arrays["coo_weights"] = np.asarray(version.host_weights)
+    np.savez_compressed(
+        path,
+        __meta__=np.frombuffer(json.dumps(meta).encode(), np.uint8),
+        **arrays,
+    )
+    obs.observe("serve.checkpoint.save_s", time.perf_counter() - t0)
+
+
+def load_version(path: str, grid: Grid):
+    """Restore a ``save_version`` snapshot onto ``grid`` as a
+    ``GraphVersion`` ready for ``GraphEngine(grid, version=...)`` or
+    ``engine.swap()``.
+
+    Same grid shape ONLY (the fleet's replicas share one mesh layout;
+    cross-shape restore would re-bucket and forfeit the bit-identical
+    shapes the zero-retrace guarantee rests on — rebuild from COO for
+    that).  Uploads are one ``device_put`` per persisted array.
+    """
+    import time
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from .. import obs
+    from ..parallel.ellmat import EllParMat
+    from ..parallel.grid import COL_AXIS, ROW_AXIS
+    from ..parallel.vec import DistMultiVec
+    from ..serve.engine import GraphVersion
+
+    t0 = time.perf_counter()
+    with np.load(path) as z:
+        meta = json.loads(bytes(z["__meta__"]).decode())
+        if meta.get("v") != VERSION_SCHEMA:
+            raise ValueError(
+                f"not a GraphVersion snapshot (schema {meta.get('v')!r}"
+                f" != {VERSION_SCHEMA!r})"
+            )
+        pr, pc = meta["grid"]
+        if (pr, pc) != (grid.pr, grid.pc):
+            raise ValueError(
+                f"snapshot was taken on a {pr}x{pc} grid; load_version "
+                f"restores onto the SAME grid shape (got {grid.pr}x"
+                f"{grid.pc}) — rebuild from COO to re-shard"
+            )
+        mats = {}
+        for nm in _VERSION_MATS:
+            info = meta["mats"].get(nm)
+            if info is None:
+                mats[nm] = None
+                continue
+            host_buckets = [
+                (
+                    z[f"{nm}.{i}.c"], z[f"{nm}.{i}.v"], z[f"{nm}.{i}.r"],
+                )
+                for i in range(info["nbuckets"])
+            ]
+            mats[nm] = EllParMat.from_host_buckets(
+                grid, host_buckets, info["nrows"], info["ncols"]
+            )
+        dangling = None
+        if "dangling" in z:
+            dangling = DistVec(
+                blocks=jax.device_put(
+                    jnp.asarray(z["dangling"]),
+                    NamedSharding(grid.mesh, P(COL_AXIS)),
+                ),
+                length=meta["ncols"], align="col", grid=grid,
+            )
+        X = None
+        if "X" in z:
+            X = DistMultiVec(
+                blocks=jax.device_put(
+                    jnp.asarray(z["X"]),
+                    NamedSharding(grid.mesh, P(ROW_AXIS)),
+                ),
+                length=meta["ncols"], align="row", grid=grid,
+            )
+        host_coo = None
+        host_weights = None
+        if "coo_rows" in z:
+            host_coo = (
+                np.asarray(z["coo_rows"]), np.asarray(z["coo_cols"]),
+                meta["ncols"],
+            )
+            if "coo_weights" in z:
+                host_weights = np.asarray(z["coo_weights"])
+        version = GraphVersion(
+            nrows=meta["nrows"], ncols=meta["ncols"], nnz=meta["nnz"],
+            E=mats["E"],
+            deg=np.asarray(z["deg"]),
+            outdeg=(
+                np.asarray(z["outdeg"]) if "outdeg" in z else None
+            ),
+            E_weighted=mats["E_weighted"],
+            P_ell=mats["P_ell"],
+            dangling=dangling,
+            ET=mats["ET"],
+            host_coo=host_coo,
+            host_weights=host_weights,
+            X=X,
+            feat_dim=meta["feat_dim"],
+            headroom=meta["headroom"],
+        )
+    obs.observe("serve.checkpoint.load_s", time.perf_counter() - t0)
+    return version
+
+
 # --- orbax (async, sharded) -------------------------------------------------
 
 

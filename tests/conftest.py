@@ -29,6 +29,16 @@ os.environ["COMBBLAS_PLAN_STORE"] = tempfile.mkdtemp(
     prefix="combblas-plans-"
 )
 
+# Hermetic pool/fleet knobs (round 14): an ambient byte budget would
+# make tier-1 pool tests evict mid-flight (shapes and retrace counts
+# would depend on the operator's fleet settings), an ambient quantum or
+# replica count would reroute the WFQ-share and fleet tests — pin the
+# defaults ("0" = default per the tuner/config convention); tests that
+# exercise the knobs themselves pass explicit arguments instead.
+os.environ["COMBBLAS_POOL_BYTE_BUDGET"] = "0"
+os.environ["COMBBLAS_POOL_QUANTUM"] = "0"
+os.environ["COMBBLAS_FLEET_REPLICAS"] = "0"
+
 import jax
 
 jax.config.update("jax_platforms", "cpu")

@@ -144,3 +144,42 @@ def test_bench_end_to_end_summary_line(tmp_path):
     assert "runs" in full and full["value"] == s["value"]
     sidecar = json.loads((tmp_path / "BENCH_SUMMARY.json").read_text())
     assert sidecar == s
+
+
+def test_pool_summary_honors_contract(tmp_path, monkeypatch):
+    """Round 14: the standalone BENCH_SERVE_POOL scenario emits the
+    SAME final-line contract (plus the per-tenant breakdown) without
+    going through bench.py's wrapper."""
+    spec = importlib.util.spec_from_file_location(
+        "serve_bench_under_test",
+        os.path.join(REPO, "benchmarks", "serve_bench.py"),
+    )
+    m = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(m)
+    monkeypatch.setenv(
+        "BENCH_SUMMARY_PATH", str(tmp_path / "BENCH_SUMMARY.json")
+    )
+    out = {
+        "metric": "serve_pool_throughput",
+        "value": 1234.5,
+        "p50_ms": 12.0,
+        "ok": True,
+        "per_tenant": {"t0": {"queries": 10, "rejected": 0}},
+        "obs_jsonl": "x" * 10000,  # giant fields are NOT copied
+    }
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = m._emit_pool_summary(out)
+    assert rc == 0
+    line = buf.getvalue().strip().splitlines()[-1]
+    s = json.loads(line)
+    assert REQUIRED_KEYS <= set(s)
+    assert s["value"] == 1234.5
+    assert s["median"] == 12.0
+    assert s["per_tenant"]["t0"]["queries"] == 10
+    mirror = json.load(open(tmp_path / "BENCH_SUMMARY.json"))
+    assert mirror == s
+    # a failed gate maps to rc=1 (the driver's capture semantics)
+    out["ok"] = False
+    with redirect_stdout(io.StringIO()):
+        assert m._emit_pool_summary(out) == 1

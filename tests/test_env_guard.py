@@ -81,3 +81,30 @@ def test_dynamic_knobs_centralized():
     assert 0 < config.dynamic_spill_frac() <= 1.0
     assert config.store_max_entries() >= 1
     assert config.store_compact_min() >= 1
+
+
+def test_pool_fleet_knobs_centralized(monkeypatch):
+    """The round-14 pool/fleet knobs parse through tuner/config with
+    the shared conventions (unset/empty/"0" = default; explicit
+    argument beats the env)."""
+    from combblas_tpu.tuner import config
+
+    for name in (
+        config.ENV_POOL_BYTE_BUDGET, config.ENV_POOL_QUANTUM,
+        config.ENV_FLEET_REPLICAS,
+    ):
+        assert name.startswith("COMBBLAS_")
+    # conftest pins these to "0" => defaults
+    assert config.pool_byte_budget() == config.DEFAULT_POOL_BYTE_BUDGET
+    assert config.pool_quantum() == config.DEFAULT_POOL_QUANTUM
+    assert config.fleet_replicas() == config.DEFAULT_FLEET_REPLICAS
+    monkeypatch.setenv(config.ENV_POOL_BYTE_BUDGET, str(1 << 20))
+    monkeypatch.setenv(config.ENV_POOL_QUANTUM, "8")
+    monkeypatch.setenv(config.ENV_FLEET_REPLICAS, "3")
+    assert config.pool_byte_budget() == 1 << 20
+    assert config.pool_quantum() == 8
+    assert config.fleet_replicas() == 3
+    # argument > env, clamped sane
+    assert config.pool_byte_budget(4096) == 4096
+    assert config.pool_quantum(1) == 1
+    assert config.fleet_replicas(5) == 5

@@ -623,3 +623,81 @@ def test_round11_dynamic_counters_gated(rng):
     finally:
         obs.disable()
         obs.reset()
+
+
+def test_round14_pool_fleet_counters_gated(rng, tmp_path):
+    """ISSUE 12 satellite: the round-14 series — pool residency
+    gauges/counters, WFQ rounds/served/deficit, fleet routing, and the
+    checkpoint histograms — are emitted under obs and cost NOTHING
+    when disabled (the zero-cost gate extended to the pool/fleet)."""
+    import os
+
+    from combblas_tpu.parallel.grid import Grid
+    from combblas_tpu.serve import EnginePool, FleetRouter, ServeConfig
+    from combblas_tpu.utils import checkpoint
+
+    grid = Grid.make(1, 1)
+    n = 32
+    r = rng.integers(0, n, 120)
+    c = rng.integers(0, n, 120)
+    rows = np.concatenate([r, c])
+    cols = np.concatenate([c, r])
+    cfg = ServeConfig(lane_widths=(1,), update_autostart=False)
+
+    def exercise(tag):
+        pool = EnginePool(grid)
+        pool.add_tenant(
+            "a", rows, cols, n, config=cfg, kinds=("bfs",)
+        )
+        psrv = pool.serve()
+        f = psrv.submit("a", "bfs", 1)
+        while psrv.pump(force=True):
+            pass
+        assert f.exception(timeout=0) is None
+        assert pool.evict("a")
+        pool.admit("a")  # re-admission: the rebuild path
+        path = os.path.join(tmp_path, f"v-{tag}.npz")
+        checkpoint.save_version(path, pool.engine("a").version)
+        checkpoint.load_version(path, grid)
+        fr = FleetRouter([pool.server("a")])
+        fr.submit("bfs", 2)
+        pool.server("a").scheduler.fail_pending(
+            RuntimeError("gate teardown")
+        )
+
+    assert not obs.ENABLED
+    exercise("off")
+    assert obs.registry.empty()  # disabled: zero bookkeeping
+
+    obs.enable(install_hooks=False)
+    try:
+        obs.reset()
+        exercise("on")
+        g = obs.registry.get_counter
+        assert g("serve.pool.admits", tenant="a") == 2  # build+rebuild
+        assert g("serve.pool.evictions", tenant="a") == 1
+        assert obs.registry.get_gauge("serve.pool.resident_bytes") > 0
+        assert obs.registry.get_gauge("serve.pool.resident_tenants") == 1
+        assert obs.registry.get_histogram(
+            "serve.pool.rebuild_s"
+        )["count"] == 2
+        assert g("serve.wfq.rounds") >= 1
+        assert g("serve.wfq.served", tenant="a") >= 1
+        assert obs.registry.get_gauge(
+            "serve.wfq.deficit", tenant="a"
+        ) is not None
+        assert g("serve.fleet.submitted", replica=0) == 1
+        assert obs.registry.get_gauge("serve.fleet.replicas") == 1
+        assert obs.registry.get_histogram(
+            "serve.checkpoint.save_s"
+        )["count"] == 1
+        assert obs.registry.get_histogram(
+            "serve.checkpoint.load_s"
+        )["count"] == 1
+        # tenant-labeled scheduler series (end-to-end labels)
+        assert obs.registry.get_gauge(
+            "serve.queue.depth", tenant="a"
+        ) is not None
+    finally:
+        obs.disable()
+        obs.reset()
