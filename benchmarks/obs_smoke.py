@@ -15,7 +15,13 @@ The trace contains, end to end (docs/observability.md has the schema):
     probe program is compiled, evicted from the in-process jit cache,
     and recompiled so the persistent cache registers a genuine hit),
   * kernel dispatch/trace counters (``spmv.dispatch``, ``trace.*``) and
-    the BFS lru-cache gauges.
+    the BFS lru-cache gauges,
+  * a SERVE-PATH request trace (round 15): a worker-less ``Server``
+    pumps a handful of BFS queries at sample rate 1.0, so the dump
+    carries schema-``trace`` records whose stage durations (queue wait
+    -> assemble -> execute -> scatter) sum to each request's
+    end-to-end latency — the smallest end-to-end latency-decomposition
+    entrypoint.
 
 tests/test_obs.py runs this in-process (2x2 grid under the 8-virtual-
 device fixture) and validates the file against the documented schema —
@@ -113,6 +119,33 @@ def run(scale: int = SCALE, edgefactor: int = EDGEFACTOR,
             discovered=ndisc,
         )
         obs.gauge("smoke.nnz", int(len(rows_u)))
+
+        # serve-path trace (round 15): every request sampled, pumped
+        # deterministically (no worker thread), stages -> JSONL
+        from combblas_tpu.obs import trace as obs_trace
+        from combblas_tpu.serve import GraphEngine, ServeConfig
+
+        prev_rate = obs_trace.sample_rate()
+        obs_trace.set_sample_rate(1.0)
+        try:
+            engine = GraphEngine.from_coo(
+                grid, rows_u, cols_u, n, kinds=("bfs",)
+            )
+            cfg = ServeConfig(
+                lane_widths=(1, 2, 4), update_autostart=False
+            )
+            with obs.span("smoke.serve"):
+                srv = engine.serve(cfg)
+                srv.warmup(widths=(1, 2, 4))
+                roots = np.flatnonzero(deg > 0)[:5]
+                futs = [srv.submit("bfs", int(x)) for x in roots]
+                while srv.pump(force=True):
+                    pass
+                for f in futs:
+                    f.result(timeout=60)
+                srv.close()
+        finally:
+            obs_trace.set_sample_rate(prev_rate)
     return obs.dump_jsonl()
 
 

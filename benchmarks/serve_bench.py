@@ -97,9 +97,45 @@ NQUERIES = int(os.environ.get("BENCH_SERVE_QUERIES", "256"))
 
 
 def _percentile(xs: list[float], q: float) -> float:
-    xs = sorted(xs)
-    i = min(len(xs) - 1, int(round(q * (len(xs) - 1))))
-    return xs[i]
+    # ONE percentile implementation repo-wide (round 15): the obs
+    # sinks' quantile helper, shared with the registry snapshot, the
+    # JSONL aggregate and the Prometheus exporter
+    from combblas_tpu.obs.sinks import quantiles
+
+    return quantiles(xs, (q,))[q]
+
+
+def _restores_trace_rate(fn):
+    """Scenario decorator: whatever sampling rate the scenario sets,
+    the PROCESS-GLOBAL rate is restored on every exit path (exception
+    included) — a later scenario or test in the same process must not
+    inherit it (the obs_smoke try/finally pattern)."""
+    import functools
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        from combblas_tpu.obs import trace as obs_trace
+
+        prev = obs_trace.sample_rate()
+        try:
+            return fn(*args, **kwargs)
+        finally:
+            obs_trace.set_sample_rate(prev)
+
+    return wrapper
+
+
+def _trace_decomposition(obs_trace) -> dict | None:
+    """Per-stage mean latency (ms) from the sampled request traces —
+    the summary-JSON latency decomposition (None when nothing was
+    sampled)."""
+    summary = obs_trace.stage_summary()
+    if not summary:
+        return None
+    return {
+        stage: round(1e3 * d["mean_s"], 3)
+        for stage, d in summary.items()
+    }
 
 
 def _setup(scale, edgefactor, width, nqueries, grid_shape, kinds,
@@ -246,6 +282,7 @@ def run(scale: int = SCALE, edgefactor: int = EDGEFACTOR,
     return out
 
 
+@_restores_trace_rate
 def run_chaos(scale: int = SCALE, edgefactor: int = EDGEFACTOR,
               width: int = WIDTH, nqueries: int | None = None,
               grid_shape=(2, 4), kinds=("bfs", "pagerank")) -> dict:
@@ -257,6 +294,15 @@ def run_chaos(scale: int = SCALE, edgefactor: int = EDGEFACTOR,
     from combblas_tpu.serve import BackpressureError, ServeConfig
 
     sidecar = obs.enable_sidecar("serve-chaos")
+    from combblas_tpu.obs import trace as obs_trace
+
+    if sidecar:
+        # sampled request traces feed the summary's latency
+        # decomposition (deterministic: same rids = same sampled set;
+        # rate restored by @_restores_trace_rate on every exit path)
+        obs_trace.set_sample_rate(
+            float(os.environ.get("BENCH_TRACE_SAMPLE", "0.25"))
+        )
     rate = float(os.environ.get("BENCH_SERVE_CHAOS_RATE", "0.05"))
     # default seed 11 fires its first 5% fault on the 4th execute call:
     # even a short, well-coalesced stream provably exercises recovery
@@ -267,6 +313,11 @@ def run_chaos(scale: int = SCALE, edgefactor: int = EDGEFACTOR,
         if nqueries is None else nqueries
     )
 
+    # a generous deadline SLO so the budget-burn surface is live under
+    # chaos: injected faults and their poisons burn the error budget
+    slo_deadline_s = float(
+        os.environ.get("BENCH_SERVE_SLO_DEADLINE_S", "30")
+    )
     widths = tuple(sorted({1, 2, 4, 8, width}))
     engine, rows, cols, _roots, stream, _load_s, _warmup_s = _setup(
         scale, edgefactor, width, nqueries, grid_shape, kinds, widths,
@@ -280,7 +331,8 @@ def run_chaos(scale: int = SCALE, edgefactor: int = EDGEFACTOR,
 
     cfg = ServeConfig(
         lane_widths=widths, max_queue=max(4 * width, nqueries),
-        max_wait_s=0.005,
+        max_wait_s=0.005, slo_deadline_s=slo_deadline_s,
+        slo_target=0.95,
     )
     lat_of: dict = {}  # future -> completion latency (ok OR failed)
 
@@ -371,6 +423,12 @@ def run_chaos(scale: int = SCALE, edgefactor: int = EDGEFACTOR,
         "kinds": list(kinds),
         "batches": stats["batches"],
         "graph_version": stats["graph_version"],
+        # round 15: sampled-trace latency decomposition + the SLO
+        # error budget's view of the chaos (burn counts the injected
+        # damage the availability gate tolerates)
+        "latency_decomposition_ms": _trace_decomposition(obs_trace),
+        "slo": stats.get("slo"),
+        "flightrec": stats.get("flightrec"),
     }
     obs.gauge("serve.bench.chaos_availability", availability)
     if sidecar:
@@ -559,6 +617,7 @@ def run_mutate(scale: int = SCALE, edgefactor: int = EDGEFACTOR,
     return out
 
 
+@_restores_trace_rate
 def run_pool(scale: int = SCALE, edgefactor: int = EDGEFACTOR,
              grid_shape=(2, 4), kinds=("bfs", "pagerank")) -> dict:
     """BENCH_SERVE_POOL=1 — the multi-tenant pool scenario (ISSUE 12);
@@ -575,6 +634,12 @@ def run_pool(scale: int = SCALE, edgefactor: int = EDGEFACTOR,
     from combblas_tpu.utils.rmat import rmat_symmetric_coo_host
 
     sidecar = obs.enable_sidecar("serve-pool")
+    from combblas_tpu.obs import trace as obs_trace
+
+    if sidecar:  # rate restored by @_restores_trace_rate
+        obs_trace.set_sample_rate(
+            float(os.environ.get("BENCH_TRACE_SAMPLE", "0.25"))
+        )
     ntenants = max(int(os.environ.get("BENCH_POOL_TENANTS", "4")), 2)
     nqueries = int(os.environ.get("BENCH_SERVE_QUERIES", "2000"))
     nwrites = int(os.environ.get("BENCH_POOL_WRITES", "16"))
@@ -589,6 +654,13 @@ def run_pool(scale: int = SCALE, edgefactor: int = EDGEFACTOR,
         lane_widths=widths, max_queue=4096, max_wait_s=0.005,
         update_flush=4, update_max_delay_s=0.01,
         update_autostart=False,  # the POOL worker merges (WFQ-charged)
+        # a generous per-tenant deadline SLO: the budget-burn column
+        # in the per-tenant breakdown is live without changing what
+        # the scenario admits (a standing backlog stays well inside)
+        slo_deadline_s=float(
+            os.environ.get("BENCH_SERVE_SLO_DEADLINE_S", "120")
+        ),
+        slo_target=0.95,
     )
     pool = EnginePool(grid)
     t0 = time.perf_counter()
@@ -759,6 +831,11 @@ def run_pool(scale: int = SCALE, edgefactor: int = EDGEFACTOR,
             "evictions": evictions[t],
             "admits": pst["tenants"][t]["admits"],
             "device_bytes": sizes[t],
+            # round 15: the tenant's SLO error-budget burn over the
+            # run's window (None when the server stats predate it)
+            "slo_burn": (
+                (stats["servers"][t].get("slo") or {}).get("burn")
+            ),
         }
         for i, t in enumerate(names)
     }
@@ -816,6 +893,12 @@ def run_pool(scale: int = SCALE, edgefactor: int = EDGEFACTOR,
         "under_budget_ok": under_budget_ok,
         "readmit_bit_exact": bit_exact,
         "per_tenant": per_tenant,
+        "latency_decomposition_ms": _trace_decomposition(obs_trace),
+        "slo_burn_worst": max(
+            (v["slo_burn"] for v in per_tenant.values()
+             if v["slo_burn"] is not None),
+            default=None,
+        ),
         "scale": scale,
         "grid": list(grid_shape),
         "kinds": list(kinds),

@@ -66,8 +66,11 @@ GRID = os.environ.get("BENCH_SPMM_GRID", "2x2")
 
 
 def _percentile(xs, q):
-    xs = sorted(xs)
-    return xs[min(len(xs) - 1, int(round(q * (len(xs) - 1))))]
+    # the shared obs quantile helper (round 15): one percentile
+    # implementation for benches, the registry, and the exporter
+    from combblas_tpu.obs.sinks import quantiles
+
+    return quantiles(xs, (q,))[q]
 
 
 def _rmat(scale, edgefactor, seed=7):

@@ -256,7 +256,18 @@ def refresh_analytic(engine, kind: str, root: int | None = None,
     ck = (kind, root if kind == "bfs" else None)
     entry = engine._analytics.get(ck)
     vid = engine.version_id
+    if entry is not None and obs.ENABLED:
+        # the ROADMAP-named freshness gauge: how many graph versions
+        # the cached analytic lags the served version at refresh time
+        # (0 = the cache answers for the current graph)
+        obs.gauge(
+            "dynamic.freshness.versions_behind",
+            vid - entry["vid"], kind=kind,
+        )
     if entry is not None and entry["vid"] == vid and not force_cold:
+        engine._refresh_modes["cached"] = (
+            engine._refresh_modes.get("cached", 0) + 1
+        )
         obs.count("dynamic.refresh.runs", kind=kind, mode="cached")
         return {**entry, "mode": "cached", "latency_s": 0.0}
 
@@ -286,9 +297,19 @@ def refresh_analytic(engine, kind: str, root: int | None = None,
     dt = time.perf_counter() - t0
     out = {"kind": kind, "vid": vid, "result": result, "niter": niter}
     engine._analytics[ck] = out
+    engine._refresh_modes[mode] = engine._refresh_modes.get(mode, 0) + 1
     obs.count("dynamic.refresh.runs", kind=kind, mode=mode)
     obs.observe("dynamic.refresh.iters", niter, kind=kind, mode=mode)
     obs.observe("dynamic.refresh.latency_s", dt, kind=kind, mode=mode)
+    if obs.ENABLED:
+        # repair-vs-cold ratio over this engine's recompute history —
+        # the streaming lane's warm-start payoff as one gauge
+        warm = engine._refresh_modes.get("warm", 0)
+        cold = engine._refresh_modes.get("cold", 0)
+        if warm + cold:
+            obs.gauge(
+                "dynamic.freshness.repair_ratio", warm / (warm + cold)
+            )
     return {
         **out, "mode": mode, "cold_reason": reason, "latency_s": dt,
     }

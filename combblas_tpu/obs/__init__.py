@@ -17,6 +17,15 @@ package is its structured, machine-readable replacement, three layers:
    schema-versioned JSONL exporter, host-side multi-process merge, and
    a device psum path for add-monoid counters.
 
+Round 15 adds the production serving surfaces (docs/observability.md
+"Serving observability"): **per-request tracing** (``trace.py`` —
+deterministic-sampled stage decompositions that sum to the e2e
+latency), the **flight recorder** (``recorder.py`` — always-on
+bounded ring dumped on failure as ``combblas_tpu.flightrec/v1``), and
+the **live export surface** (``export.py`` — Prometheus text
+exposition with reservoir quantiles + the stdlib-HTTP scrape thread
+``Server.serve_metrics`` attaches).
+
 COST CONTRACT: everything is guarded by the module-level ``ENABLED``
 flag, checked before any dict work — with telemetry off, an
 instrumented call site costs one attribute read (and ``span`` returns a
@@ -46,6 +55,7 @@ import os
 
 from .metrics import MetricsRegistry
 from .sinks import (
+    FLIGHTREC_SCHEMA,
     SCHEMA,
     SCHEMA_VERSION,
     aggregate,
@@ -53,10 +63,13 @@ from .sinks import (
     merge_jsonl_files,
     parse_jsonl,
     psum_counters,
+    quantile_summary,
+    quantiles,
     validate_record,
     write_jsonl,
 )
 from .spans import NULL_SPAN, SpanTracker
+from . import trace as _trace
 
 #: Master switch, checked at every instrumentation site BEFORE any work.
 #: Off by default: the hot paths must cost nothing unless telemetry is
@@ -123,9 +136,11 @@ def enabled() -> bool:
 
 
 def reset() -> None:
-    """Clear every metric, span, and event (the flag is untouched)."""
+    """Clear every metric, span, event, and per-request trace (the
+    flag is untouched)."""
     registry.clear()
     _spans.clear()
+    _trace.clear()
 
 
 def reset_spans() -> None:
@@ -177,6 +192,39 @@ def span_event(name: str, **fields) -> None:
     if not ENABLED:
         return
     _spans.event(name, **fields)
+
+
+# --- per-request tracing (round 15, obs/trace.py) ---------------------------
+
+
+def request_trace(rid, kind: str | None = None,
+                  tenant: str | None = None):
+    """Open a deterministic-sampled per-request trace (None when obs
+    is off or the sampler declines ``rid``) — the serve read lane's
+    entry.  One function call + flag check when disabled."""
+    if not ENABLED:
+        return None
+    return _trace.begin(rid, "serve.request", kind=kind, tenant=tenant)
+
+
+def update_trace(rid, tenant: str | None = None):
+    """The write lane's trace entry (``name="serve.update"``)."""
+    if not ENABLED:
+        return None
+    return _trace.begin(rid, "serve.update", tenant=tenant)
+
+
+def trace_records() -> list[dict]:
+    """Completed per-request trace records (schema kind ``trace``)."""
+    return _trace.records()
+
+
+def prune_labels(**labels) -> int:
+    """Drop every registry series labeled with ALL the given pairs
+    (tenant-churn label-space hygiene; works whether or not telemetry
+    is currently enabled — stale series from an earlier enabled phase
+    must still be removable)."""
+    return registry.prune_labels(**labels)
 
 
 # --- providers (pull-style gauges, polled at export time) -------------------
@@ -247,7 +295,8 @@ def dump_jsonl(path: str | None = None, *, process: int | None = None,
             process, nprocs = process or 0, nprocs or 1
     _run_providers()
     records = encode_records(
-        registry.snapshot(), _spans, process=process, nprocs=nprocs
+        registry.snapshot(), _spans, process=process, nprocs=nprocs,
+        traces=_trace.records(),
     )
     return write_jsonl(path, records)
 
@@ -296,14 +345,21 @@ def install_jax_hooks() -> bool:
     return True
 
 
+#: The per-request tracing module (``obs.trace`` — sampling knobs,
+#: ``stage_summary`` for bench decompositions).
+trace = _trace
+
 __all__ = [
     "ENABLED", "DEVICE_SYNC", "SCHEMA", "SCHEMA_VERSION",
+    "FLIGHTREC_SCHEMA",
     "enable", "disable", "enabled", "enable_sidecar", "reset",
     "reset_spans",
     "count", "gauge", "observe", "span", "span_event",
+    "request_trace", "update_trace", "trace_records", "prune_labels",
     "register_provider", "report", "print_report", "span_seconds",
     "metrics_snapshot", "dump_jsonl", "install_jax_hooks",
     "parse_jsonl", "merge_jsonl_files", "aggregate", "validate_record",
     "encode_records", "write_jsonl", "psum_counters", "registry",
+    "quantiles", "quantile_summary", "trace",
     "MetricsRegistry", "SpanTracker", "NULL_SPAN",
 ]

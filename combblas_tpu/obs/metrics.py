@@ -494,16 +494,189 @@ Pre-existing serve series gain a ``tenant`` label when the emitting
 scheduler/breaker is owned by a pool tenant (``serve.queue.depth``,
 ``serve.queue.rejected``, ``serve.requests``, ``serve.breaker.*``);
 single-tenant servers emit the unchanged label sets.
+
+Foundation series (rounds 1-5 — cataloged here since round 15; the
+static catalog-drift sweep in tests/test_obs_catalog.py asserts every
+literal ``obs.count/gauge/observe`` series name in the package appears
+in this docstring):
+
+=====================================  =========  =====================
+name                                   kind       meaning
+=====================================  =========  =====================
+``spgemm.symbolic_fill_slots``         counter    symbolic fill-in of a
+                                                  product (pre-launch)
+``spgemm.realized_nnz``                counter    realized output nnz
+                                                  (DEVICE_SYNC only)
+``spgemm.load_imbalance``              gauge      max/mean per-tile
+                                                  flops (the
+                                                  reference's
+                                                  LoadImbalance)
+``spgemm.phases``                      gauge      multi-phase SpGEMM
+                                                  phase count
+``spgemm.phase_adjusted``              counter    phase counts adjusted
+                                                  upward by the memory
+                                                  estimator
+``spgemm.scan.overflow_retries``       counter    scan-tier capacity
+                                                  retries
+``spgemm.scan.overflow_slots``         counter    slots dropped pre-
+                                                  retry (always
+                                                  retried to zero)
+``spgemm.mxu.overflow_retries``        counter    mxu-tier extraction
+                                                  retries
+``trace.summa_spgemm_mxu``             counter    TRACE-TIME kernel
+                                                  (re)traces (mxu tier)
+``trace.summa_spgemm_scan``            counter    TRACE-TIME kernel
+                                                  (re)traces (scan
+                                                  tier)
+``trace.redistribute_coo``             counter    TRACE-TIME
+                                                  redistribute
+                                                  (re)traces
+``redistribute.dropped``               counter    entries dropped by a
+                                                  capacity-bounded
+                                                  route (0 = complete)
+``redistribute.retries``               counter    capacity-doubling
+                                                  retries
+``redistribute.stage_capacity``        gauge      per-stage routing
+                                                  capacity of the last
+                                                  call
+``redistribute.tile_capacity``         gauge      per-tile landing
+                                                  capacity of the last
+                                                  call
+``spmv.dispatch``                      counter    SpMV dispatches per
+                                                  kernel (labels:
+                                                  ``kernel``)
+``compile_cache.hits/misses``          counter    persistent XLA cache
+                                                  traffic (the
+                                                  jax.monitoring
+                                                  bridge)
+``compile_cache.entries``              gauge      cache files on disk
+                                                  (labels ``cache`` =
+                                                  xla / plans)
+``compile_cache.disabled``             counter    enable_compile_cache
+                                                  refusals (cache dir
+                                                  conflicts)
+``mcl.perturb_kicks``                  counter    MCL chaos-plateau
+                                                  perturbation kicks
+``mcl.block_rerolls``                  counter    MCL sparse-block
+                                                  capacity rerolls
+``k1.*`` (``k1.<stage>_s``)            histogram  Graph500 kernel-1
+                                                  stage seconds
+``cache.bfs.*``                        gauge      BFS lru-cache
+                                                  hit/miss/size gauges
+                                                  (provider-polled)
+``serve.plan_cache.hits`` /            counter    engine plan-cache
+``serve.plan_cache.misses``
+                                                  traffic (labels
+                                                  ``kind``, ``width``)
+``trace.serve``                        counter    TRACE-TIME serve plan
+                                                  (re)traces — the
+                                                  zero-retrace gate
+``serve.queue.depth``                  gauge      pending requests
+``serve.queue.rejected``               counter    backpressure rejects
+                                                  (labels ``kind``)
+``serve.requests``                     counter    request dispositions
+                                                  (labels ``kind``,
+                                                  ``status`` = ok /
+                                                  error / timeout /
+                                                  invalid / cancelled)
+``serve.request.latency_s``            histogram  submit-to-settle
+                                                  latency (labels
+                                                  ``kind``)
+``serve.batch.occupancy``              histogram  live lanes / bucket
+                                                  width per batch
+``serve.batch.padding_waste``          histogram  pad lanes per batch
+``serve.batches``                      gauge      total batches
+                                                  executed
+``obs.provider_errors``                counter    broken pull-provider
+                                                  callbacks (caught)
+``serve.bench.*``                      gauge      bench-scenario
+                                                  headline gauges
+                                                  (serve_bench.py)
+=====================================  =========  =====================
+
+Production-observability series (round 15 — per-request tracing, the
+flight recorder, SLO error budgets, freshness gauges and the scrape
+surface; docs/observability.md "Serving observability"):
+
+========================================  =========  ==================
+name                                      kind       meaning
+========================================  =========  ==================
+``serve.trace.sampled``                   counter    requests whose
+                                                     deterministic
+                                                     sample-hash
+                                                     admitted a trace
+                                                     (labels ``lane`` =
+                                                     request / update)
+``serve.trace.dropped``                   counter    completed traces
+                                                     dropped by the
+                                                     bounded trace log
+``serve.flightrec.events``                counter    events recorded
+                                                     into flight-
+                                                     recorder rings
+``serve.flightrec.dumps``                 counter    ring snapshots
+                                                     written (labels
+                                                     ``reason`` =
+                                                     worker_error /
+                                                     breaker_open /
+                                                     poisoned /
+                                                     merge_failed /
+                                                     slo_breach /
+                                                     manual)
+``serve.slo.good``                        counter    requests that met
+                                                     the SLO deadline
+                                                     (labels ``kind``
+                                                     [, ``tenant``])
+``serve.slo.bad``                         counter    requests that blew
+                                                     it — timeout,
+                                                     error, poisoned,
+                                                     rejected (labels
+                                                     ``kind``
+                                                     [, ``tenant``])
+``serve.slo.budget_burn``                 gauge      rolling-window bad
+                                                     count over the
+                                                     error budget
+                                                     ``(1 - target) x
+                                                     window total``;
+                                                     >= 1 = budget
+                                                     exhausted (labels
+                                                     [``tenant``])
+``dynamic.freshness.versions_behind``     gauge      graph versions
+                                                     between a cached
+                                                     analytic and the
+                                                     served version at
+                                                     refresh time
+                                                     (labels ``kind``)
+``dynamic.freshness.repair_ratio``        gauge      warm / (warm +
+                                                     cold) refresh
+                                                     runs on this
+                                                     engine — the
+                                                     repair-vs-cold
+                                                     ratio the
+                                                     streaming bench
+                                                     gates on
+``obs.scrape.requests``                   counter    HTTP scrape hits
+                                                     (labels ``path``)
+========================================  =========  ==================
 """
 
 from __future__ import annotations
 
 import threading
 
+from .sinks import quantile_summary
+
 #: Metric-kind tags used in snapshots and the JSONL schema.
 KIND_COUNTER = "counter"
 KIND_GAUGE = "gauge"
 KIND_HISTOGRAM = "histogram"
+
+#: Per-histogram sample reservoir size (round 15): the last RESERVOIR
+#: observations ride along in snapshots so quantile summaries
+#: (p50/p95/p99) are computable ONCE (``sinks.quantile_summary``) for
+#: the Prometheus exporter, ``aggregate()`` and the bench sidecars —
+#: instead of every bench keeping its own latency list.  Overflow
+#: overwrites in arrival order (a sliding window of recent values).
+RESERVOIR = 512
 
 
 def _label_key(labels: dict) -> tuple:
@@ -523,7 +696,9 @@ class MetricsRegistry:
         self._lock = threading.Lock()
         self._counters: dict[tuple, float] = {}
         self._gauges: dict[tuple, float] = {}
-        self._hists: dict[tuple, list] = {}  # [count, sum, min, max]
+        # [count, sum, min, max, samples] — samples is the bounded
+        # quantile reservoir (RESERVOIR), overwritten in arrival order
+        self._hists: dict[tuple, list] = {}
 
     def _key(self, name: str, labels: dict) -> tuple:
         # labels live inside the key (sorted tuple); snapshot()
@@ -545,12 +720,17 @@ class MetricsRegistry:
             key = self._key(name, labels)
             h = self._hists.get(key)
             if h is None:
-                self._hists[key] = [1, value, value, value]
+                self._hists[key] = [1, value, value, value, [value]]
             else:
                 h[0] += 1
                 h[1] += value
                 h[2] = min(h[2], value)
                 h[3] = max(h[3], value)
+                samples = h[4]
+                if len(samples) < RESERVOIR:
+                    samples.append(value)
+                else:  # sliding window: overwrite in arrival order
+                    samples[(h[0] - 1) % RESERVOIR] = value
 
     # -- readers -----------------------------------------------------------
     def get_counter(self, name: str, default=0, **labels):
@@ -563,7 +743,10 @@ class MetricsRegistry:
         h = self._hists.get((name, _label_key(labels)))
         if h is None:
             return None
-        return {"count": h[0], "sum": h[1], "min": h[2], "max": h[3]}
+        return {
+            "count": h[0], "sum": h[1], "min": h[2], "max": h[3],
+            **quantile_summary(h[4]),
+        }
 
     def empty(self) -> bool:
         return not (self._counters or self._gauges or self._hists)
@@ -588,8 +771,36 @@ class MetricsRegistry:
                     "kind": KIND_HISTOGRAM, "name": name,
                     "labels": dict(lk), "count": h[0], "sum": h[1],
                     "min": h[2], "max": h[3],
+                    # the bounded reservoir + its quantile summary:
+                    # computed HERE once, reused by the exporter,
+                    # aggregate() and the bench sidecars
+                    "samples": [round(float(v), 9) for v in h[4]],
+                    **quantile_summary(h[4]),
                 })
             return out
+
+    def prune_labels(self, **labels) -> int:
+        """Delete every series whose label set CONTAINS all the given
+        ``key=value`` pairs (round 15: the tenant-churn label-space
+        prune — a removed pool tenant's ``tenant=...`` series must not
+        live in the registry, and its scrape surface, forever).
+        Returns the number of series removed."""
+        items = tuple(labels.items())
+        if not items:
+            return 0
+
+        def hit(lk: tuple) -> bool:
+            d = dict(lk)
+            return all(d.get(k) == v for k, v in items)
+
+        removed = 0
+        with self._lock:
+            for store in (self._counters, self._gauges, self._hists):
+                dead = [k for k in store if hit(k[1])]
+                for k in dead:
+                    del store[k]
+                removed += len(dead)
+        return removed
 
     def clear(self):
         with self._lock:

@@ -16,7 +16,18 @@ kinds and their required fields:
     event      name (str), ts (float)  — span-less, process-level
     counter    name (str), value (number), labels (obj)
     gauge      name (str), value (number), labels (obj)
-    histogram  name (str), count (int), sum/min/max (number), labels (obj)
+    histogram  name (str), count (int), sum/min/max (number), labels (obj);
+               optional samples (list) + p50/p95/p99 (the round-15
+               reservoir quantiles, computed by the registry snapshot)
+    trace      name (str), rid (int|str), ts (float), wall_s (>= 0),
+               stages (list of {"stage", "s"} summing to wall_s),
+               labels (obj) — one served request's latency
+               decomposition (round 15, ``obs/trace.py``)
+
+Flight-recorder snapshots (round 15, ``obs/recorder.py``) are JSONL
+files under ``combblas_tpu.flightrec/v1``: one meta line carrying that
+schema plus a ``reason`` field, then ordinary ``event`` records — the
+same validator accepts both schemas.
 
 Multihost aggregation: each process dumps its own file (the exporter
 stamps ``process``); ``merge_jsonl_files`` merges them host-side —
@@ -37,7 +48,44 @@ import time
 SCHEMA = "combblas_tpu.obs/v1"
 SCHEMA_VERSION = 1
 
-_KINDS = ("meta", "span", "event", "counter", "gauge", "histogram")
+#: Flight-recorder snapshot schema (round 15, ``obs/recorder.py``): a
+#: dump file is one meta line under THIS schema (plus ``reason``)
+#: followed by ordinary ``event`` records — parse_jsonl validates both.
+FLIGHTREC_SCHEMA = "combblas_tpu.flightrec/v1"
+
+_KINDS = ("meta", "span", "event", "counter", "gauge", "histogram",
+          "trace")
+_META_SCHEMAS = (SCHEMA, FLIGHTREC_SCHEMA)
+
+#: Quantiles every histogram summary carries (round 15): computed ONCE
+#: here and reused by the Prometheus exporter and the bench sidecars —
+#: benches must not re-derive percentiles by hand.
+QUANTILES = (0.5, 0.95, 0.99)
+
+
+def quantiles(values, qs=QUANTILES) -> dict:
+    """Linear-interpolation quantiles of a sample list:
+    ``{q: value}`` (None-valued when ``values`` is empty).  The one
+    percentile implementation the registry snapshot, ``aggregate()``,
+    the exporter and every bench share."""
+    vs = sorted(float(v) for v in values)
+    out: dict = {}
+    for q in qs:
+        if not vs:
+            out[q] = None
+            continue
+        pos = float(q) * (len(vs) - 1)
+        lo = int(pos)
+        hi = min(lo + 1, len(vs) - 1)
+        out[q] = vs[lo] + (vs[hi] - vs[lo]) * (pos - lo)
+    return out
+
+
+def quantile_summary(values) -> dict:
+    """``{"p50": ..., "p95": ..., "p99": ...}`` from a sample list —
+    the field names histogram records and aggregate summaries carry."""
+    qs = quantiles(values)
+    return {f"p{int(q * 100)}": v for q, v in qs.items()}
 
 
 def validate_record(rec: dict) -> None:
@@ -63,14 +111,34 @@ def validate_record(rec: dict) -> None:
         raise ValueError(f"unknown kind {kind!r}")
     if kind == "meta":
         need("schema", str)
-        if rec["schema"] != SCHEMA:
+        if rec["schema"] not in _META_SCHEMAS:
             raise ValueError(f"unknown schema {rec['schema']!r}")
         need("ts", numbers.Real)
         need("process", numbers.Integral)
         need("nprocs", numbers.Integral)
         return
     need("name", str)
-    if kind == "span":
+    if kind == "trace":
+        # per-request serve trace (round 15, obs/trace.py): stage
+        # durations sum to wall_s — the latency decomposition record
+        if "rid" not in rec or not isinstance(
+            rec["rid"], (numbers.Integral, str)
+        ):
+            raise ValueError("trace.rid missing or not int/str")
+        need("ts", numbers.Real)
+        need("wall_s", numbers.Real)
+        if rec["wall_s"] < 0:
+            raise ValueError("trace.wall_s < 0")
+        need("stages", list)
+        for st in rec["stages"]:
+            if (
+                not isinstance(st, dict)
+                or not isinstance(st.get("stage"), str)
+                or not isinstance(st.get("s"), numbers.Real)
+            ):
+                raise ValueError(f"malformed trace stage: {st!r}")
+        need("labels", dict)
+    elif kind == "span":
         need("path", str)
         need("ts", numbers.Real)
         need("wall_s", numbers.Real)
@@ -91,9 +159,10 @@ def validate_record(rec: dict) -> None:
 
 
 def encode_records(metric_records, span_tracker, *, process: int = 0,
-                   nprocs: int = 1) -> list[dict]:
+                   nprocs: int = 1, traces=()) -> list[dict]:
     """Assemble the full schema record list from a registry snapshot and a
-    SpanTracker (one meta line first, then spans, events, metrics)."""
+    SpanTracker (one meta line first, then spans, events, per-request
+    traces, metrics)."""
     meta = {
         "v": SCHEMA_VERSION, "kind": "meta", "schema": SCHEMA,
         "ts": time.time(), "process": int(process), "nprocs": int(nprocs),
@@ -105,6 +174,8 @@ def encode_records(metric_records, span_tracker, *, process: int = 0,
         out.append({"v": SCHEMA_VERSION, "kind": "span", **rec})
     for rec in span_tracker.events:
         out.append({"v": SCHEMA_VERSION, "kind": "event", **rec})
+    for rec in traces:
+        out.append({"v": SCHEMA_VERSION, "kind": "trace", **rec})
     for rec in metric_records:
         out.append({"v": SCHEMA_VERSION, **rec})
     return out
@@ -145,9 +216,11 @@ def aggregate(records) -> dict:
     counters: dict = {}
     gauges: dict = {}
     hists: dict = {}
+    hist_samples: dict = {}
     span_table: dict = {}
     spans = []
     events = []
+    traces = []
     nprocs = set()
     proc = 0
     for rec in records:
@@ -182,6 +255,20 @@ def aggregate(records) -> dict:
                 h[1] += rec["sum"]
                 h[2] = min(h[2], rec["min"])
                 h[3] = max(h[3], rec["max"])
+            # reservoir samples ride along (metrics.py snapshots them):
+            # concatenating across processes lets the quantile summary
+            # below be computed ONCE, here, for everyone downstream.
+            # The merge buffer is bounded ELEMENT-wise — a block-wise
+            # gate would drop late processes' reservoirs wholesale and
+            # silently bias the merged quantiles toward early files
+            samples = rec.get("samples")
+            if samples:
+                buf = hist_samples.setdefault(key, [])
+                take = 8192 - len(buf)
+                if take > 0:
+                    buf.extend(samples[:take])
+        elif kind == "trace":
+            traces.append({**rec, "process": rec.get("process", proc)})
         elif kind == "span":
             a = span_table.setdefault(rec["name"], [0.0, 0])
             a[0] += rec["wall_s"]
@@ -196,13 +283,18 @@ def aggregate(records) -> dict:
                    for k, v in sorted(gauges.items())},
         "histograms": {
             k[0] + _label_suffix(k[1]): {
-                "count": h[0], "sum": h[1], "min": h[2], "max": h[3]
+                "count": h[0], "sum": h[1], "min": h[2], "max": h[3],
+                **(
+                    quantile_summary(hist_samples[k])
+                    if k in hist_samples else {}
+                ),
             }
             for k, h in sorted(hists.items())
         },
         "span_table": {k: (v[0], v[1]) for k, v in sorted(span_table.items())},
         "spans": spans,
         "events": events,
+        "traces": traces,
         "processes": sorted(nprocs) or [0],
     }
 

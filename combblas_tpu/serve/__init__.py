@@ -61,12 +61,13 @@ from .scheduler import (
 from .api import Server
 from .pool import EnginePool, PoolServer
 from .fleet import FleetRouter
+from .slo import ErrorBudget
 
 __all__ = [
     "GraphEngine", "GraphVersion", "Server", "ServeConfig", "Scheduler",
     "BackpressureError", "CircuitBreaker", "CircuitBreakerOpen",
     "DeficitRoundRobin", "EnginePool", "PoolServer", "FleetRouter",
-    "FaultInjector", "InjectedFault", "FAULT_POINTS",
+    "FaultInjector", "InjectedFault", "FAULT_POINTS", "ErrorBudget",
     "Request", "KINDS",
     "bucket_width", "assemble", "scatter",
 ]

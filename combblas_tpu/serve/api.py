@@ -38,9 +38,11 @@ from collections import deque
 from concurrent.futures import Future
 
 from .. import obs
+from ..obs.recorder import FlightRecorder
 from . import batcher
 from .faults import FaultInjector
 from .scheduler import BackpressureError, Scheduler, ServeConfig, _bump
+from .slo import ErrorBudget
 
 
 class Server:
@@ -62,6 +64,38 @@ class Server:
         # default (one attribute read per check); chaos tests and the
         # chaos bench arm rules on this instance
         self.faults = FaultInjector()
+        # -- production observability (round 15). The flight recorder
+        # is ALWAYS ON by default (one ring append per batch, next to a
+        # device launch; config.flight_recorder=False = one attribute
+        # read); the SLO error budget exists only when a deadline SLO
+        # is configured.  The scheduler shares the budget so rejection
+        # and queue-sweep dispositions land in the same window.
+        self._recorder = (
+            FlightRecorder(
+                capacity=self.config.flight_recorder_events,
+                out_dir=self.config.flight_recorder_dir,
+                min_interval_s=(
+                    self.config.flight_recorder_min_interval_s
+                ),
+                tenant=tenant,
+            )
+            if self.config.flight_recorder else None
+        )
+        self.slo = (
+            ErrorBudget(
+                self.config.slo_target, self.config.slo_window_s,
+                tenant=tenant,
+            )
+            if self.config.slo_deadline_s is not None else None
+        )
+        self.scheduler.slo = self.slo
+        # scheduler-side bad records (rejections, queue sweeps) can be
+        # the ones that burn through the budget — the breach dump must
+        # fire no matter which side the crossing lands on
+        self.scheduler.slo_breach = (
+            lambda kind: self._flight_dump("slo_breach", query=kind)
+        )
+        self._scrape = None  # obs.export.ScrapeServer (serve_metrics)
         self._wake = threading.Condition()
         self._stop = False
         self._worker: threading.Thread | None = None
@@ -86,7 +120,8 @@ class Server:
         # mutator and apply a batch against a stale parent version.
         self._upd_cond = threading.Condition()
         self._upd_buffer = None  # lazy dynamic.DeltaBuffer
-        self._upd_futs: deque = deque()  # (last_seq, Future)
+        # (last_seq, Future, RequestTrace | None) per admitted batch
+        self._upd_futs: deque = deque()
         self._upd_stop = False
         self._mutator: threading.Thread | None = None
         self._merge_mutex = threading.Lock()
@@ -156,6 +191,21 @@ class Server:
         # graph, and the read drain above must run on one consistent
         # execution stream either way (the engine lock serializes)
         self._stop_mutator(drain, timeout)
+        if self._scrape is not None:
+            from ..obs import export
+
+            export.detach_scrape(self)
+
+    def serve_metrics(self, port: int = 0, host: str = "127.0.0.1"
+                      ) -> int:
+        """Attach the live scrape surface (round 15): a stdlib-HTTP
+        daemon thread serving ``/metrics`` (Prometheus text rendered
+        from the obs registry), ``/healthz`` and ``/statz`` for this
+        server.  ``port=0`` binds an ephemeral port; the bound port is
+        returned.  Stopped by ``close()``."""
+        from ..obs import export
+
+        return export.attach_scrape(self, port=port, host=host)
 
     def __enter__(self) -> "Server":
         return self.start()
@@ -264,7 +314,15 @@ class Server:
                 obs.count("serve.update.invalid")
                 fut.set_exception(e)
                 return fut
-            self._upd_futs.append((last, fut))
+            # write-lane trace (round 15): buffer wait -> merge ->
+            # [fanout ->] swap -> settle; rid keyed by the batch's last
+            # sequence number, so sampling is deterministic per op set
+            tr = obs.update_trace(f"upd-{last}", tenant=self.tenant)
+            if tr is not None:
+                # the fleet's fan-out callback marks its stage through
+                # this handle (it only ever sees the future)
+                fut._combblas_trace = tr
+            self._upd_futs.append((last, fut, tr))
             self.updates_submitted += 1
             obs.count("serve.update.submitted")
             if self.config.update_autostart:
@@ -318,14 +376,30 @@ class Server:
                         self._upd_futs
                         and self._upd_futs[0][0] <= batch.last_seq
                     ):
-                        futs.append(self._upd_futs.popleft()[1])
+                        _seq, f, tr = self._upd_futs.popleft()
+                        futs.append((f, tr))
             if batch is None:
                 return 0
+            traces = [tr for _f, tr in futs if tr is not None]
+            t_drain = time.perf_counter()
+            for tr in traces:
+                tr.mark("buffer_wait", now=t_drain)
+            rec = self._recorder
             try:
                 self.faults.check("update.merge", nops=len(batch))
                 version = self.engine.apply_delta(batch)
+                t_merge = time.perf_counter()
+                for tr in traces:
+                    tr.mark("merge", now=t_merge)
                 res = self.swap_graph(version)
+                t_swap = time.perf_counter()
                 st = version.dyn.last_stats
+                for tr in traces:
+                    tr.mark("swap", now=t_swap)
+                    tr.annotate(
+                        mode=st.mode, ops=len(batch),
+                        version=res["version"],
+                    )
                 self.update_merges += 1
                 self._merge_modes[st.mode] = (
                     self._merge_modes.get(st.mode, 0) + 1
@@ -335,6 +409,13 @@ class Server:
                 )
                 obs.count("serve.update.merges", mode=st.mode)
                 obs.observe("serve.update.coalesced", len(batch))
+                if rec is not None:
+                    rec.record(
+                        "serve.merge", ops=len(batch), mode=st.mode,
+                        outcome="ok", version=res["version"],
+                        merge_s=round(t_merge - t_drain, 6),
+                        swap_s=round(t_swap - t_merge, 6),
+                    )
                 payload = {
                     "version": res["version"],
                     "nnz": res["nnz"],
@@ -342,16 +423,33 @@ class Server:
                     "ops": len(batch),
                     "merge_s": st.latency_s,
                 }
-                for f in futs:
+                # settle BEFORE finishing the traces: done-callbacks
+                # run synchronously inside settle, and the fleet's
+                # fan-out callback marks its stage through the trace
+                # handle stashed on the future — finishing afterwards
+                # lets that mark land inside the committed record
+                for f, _tr in futs:
                     batcher.settle(f, result=payload)
+                for tr in traces:
+                    tr.finish(status="ok", stage="settle")
             except Exception as e:  # failure touches THIS batch only:
                 # the old version keeps serving, later merges proceed
                 self.update_failures += 1
                 obs.count(
                     "serve.update.failed", exc_type=type(e).__name__
                 )
-                for f in futs:
+                if rec is not None:
+                    rec.record(
+                        "serve.merge", ops=len(batch),
+                        outcome="error", error=repr(e),
+                    )
+                self._flight_dump(
+                    "merge_failed", ops=len(batch), error=repr(e)
+                )
+                for f, _tr in futs:
                     batcher.settle(f, exc=e)
+                for tr in traces:
+                    tr.finish(status="error", stage="settle")
             return len(batch)
 
     def _mutate_loop(self) -> None:
@@ -391,13 +489,16 @@ class Server:
                 b = self._upd_buffer
                 if b is not None:
                     b.drain()
-                futs = [f for _s, f in self._upd_futs]
+                futs = [(f, t) for _s, f, t in self._upd_futs]
                 self._upd_futs.clear()
             self._upd_cond.notify_all()
         if not drain:
             exc = RuntimeError("serve.Server closed without drain")
-            for f in futs:
+            for f, tr in futs:
                 batcher.settle(f, exc=exc)
+                if tr is not None:  # abandoned writes still close
+                    # their sampled trace (status tells the story)
+                    tr.finish(status="aborted", stage="settle")
         if self._mutator is not None:
             self._mutator.join(timeout)
             if self._mutator.is_alive():
@@ -416,6 +517,32 @@ class Server:
 
     # -- worker ------------------------------------------------------------
 
+    def _flight_dump(self, reason: str, **extra):
+        """Snapshot the flight-recorder ring (no-op when disabled;
+        rate-limited inside the recorder)."""
+        rec = self._recorder
+        if rec is None:
+            return None
+        return rec.dump(reason, **extra)
+
+    def _slo_bad(self, kind: str) -> None:
+        """One bad SLO disposition; a budget-burn crossing dumps the
+        flight recorder (the post-mortem is cheapest NOW, while the
+        ring still holds the window that burned the budget)."""
+        if self.slo is not None and self.slo.record(False, kind=kind):
+            self._flight_dump("slo_breach", query=kind)
+
+    def _slo_ok(self, req) -> None:
+        if self.slo is not None:
+            self.slo.record(True, kind=req.kind)
+
+    def _on_exec_timeout(self, req) -> None:
+        _bump(self._timeout_exec, req.kind)
+        self._slo_bad(req.kind)
+
+    def _on_lane_error(self, req) -> None:
+        self._slo_bad(req.kind)
+
     def _drop_dead(self, reqs, now: float | None = None) -> list:
         """Deadline enforcement at EXECUTION time: a request that is
         already settled (client cancel) or already past its deadline is
@@ -430,8 +557,7 @@ class Server:
                 continue
             if r.expired(now):
                 batcher.expire(
-                    r, "expired before execution",
-                    lambda q: _bump(self._timeout_exec, q.kind),
+                    r, "expired before execution", self._on_exec_timeout
                 )
             else:
                 live.append(r)
@@ -442,12 +568,33 @@ class Server:
         requests, run, and on failure hand the survivors to the
         bisection retrier. Top-level outcomes (not bisection
         sub-batches) feed the kind's circuit breaker, so one poisoned
-        request cannot open it."""
+        request cannot open it.
+
+        Observability (round 15): sampled requests' traces MARK each
+        stage transition here (queue wait / retry wait -> assemble ->
+        execute -> scatter; the marks telescope to the e2e latency),
+        and the always-on flight recorder takes one per-batch event
+        with the same stage decomposition — per batch, not per
+        request, so it can afford to run unconditionally."""
         live = self._drop_dead(reqs)
         if not live:
             return
         kind = live[0].kind
         breaker = self.scheduler.breakers.get(kind)
+        rec = self._recorder
+        t_pop = time.perf_counter()
+        # oldest request's wait at pop time (monotonic base, matching
+        # Request.submitted_at) — the recorder's queue-wait fact
+        wait_s = time.monotonic() - live[0].submitted_at
+        # the wait a request pays BEFORE the worker picks it up:
+        # queue/flush wait at top level (in a pool, this includes the
+        # WFQ credit wait — one number, by design), sibling-bisection
+        # wait on retry sub-batches
+        stage0 = "queue_wait" if toplevel else "retry_wait"
+        for r in live:
+            if r.trace is not None:
+                r.trace.mark(stage0, now=t_pop)
+        t_asm = t_exec = None
         try:
             self.faults.check("batch.assemble", kind=kind,
                               width=len(live))
@@ -462,21 +609,71 @@ class Server:
                 self._occupancy_sum += len(live) / len(sources)
             else:
                 self.retry_batches += 1
+            t_asm = time.perf_counter()
+            for r in live:
+                if r.trace is not None:
+                    r.trace.mark("assemble", now=t_asm)
             self.faults.check(
                 "engine.execute", kind=kind,
                 roots=tuple(r.root for r in live),
             )
+            pm = self.engine.plan_misses
             result = self.engine.execute(kind, sources)
+            t_exec = time.perf_counter()
+            plan_src = "cold" if self.engine.plan_misses > pm else "warm"
+            for r in live:
+                if r.trace is not None:
+                    r.trace.mark("execute", now=t_exec)
+                    r.trace.annotate(
+                        width=len(sources), plan=plan_src,
+                        version=self.engine.version_id,
+                    )
             self.faults.check("batch.scatter", kind=kind)
             self.completed += batcher.scatter(
                 live, result,
-                on_timeout=lambda r: _bump(self._timeout_exec, r.kind),
+                on_timeout=self._on_exec_timeout,
+                on_ok=self._slo_ok if self.slo is not None else None,
+                on_error=(
+                    self._on_lane_error
+                    if self.slo is not None else None
+                ),
             )
+            if rec is not None:
+                now = time.perf_counter()
+                rec.record(
+                    "serve.batch", query=kind, width=len(sources),
+                    requests=len(live), toplevel=toplevel,
+                    outcome="ok", plan=plan_src,
+                    version=self.engine.version_id,
+                    queue_wait_s=round(wait_s, 6),
+                    assemble_s=round(t_asm - t_pop, 6),
+                    execute_s=round(t_exec - t_asm, 6),
+                    scatter_s=round(now - t_exec, 6),
+                    rids=[r.rid for r in live],
+                )
             if breaker is not None and toplevel:
                 breaker.record_success(time.monotonic(), kind)
         except Exception as e:  # failure touches THIS batch only
+            now = time.perf_counter()
+            for r in live:
+                if r.trace is not None:
+                    # however far the batch got, the elapsed time was
+                    # execution-side work: charge it there so retry
+                    # marks stay telescoping
+                    r.trace.mark("execute", now=now)
+            if rec is not None:
+                rec.record(
+                    "serve.batch", query=kind, requests=len(live),
+                    toplevel=toplevel, outcome="error",
+                    error=repr(e),
+                    elapsed_s=round(now - t_pop, 6),
+                    rids=[r.rid for r in live],
+                )
             if breaker is not None and toplevel:
-                breaker.record_failure(time.monotonic(), kind)
+                if breaker.record_failure(time.monotonic(), kind):
+                    self._flight_dump(
+                        "breaker_open", query=kind, error=repr(e)
+                    )
             self._recover(live, e)
 
     def _recover(self, reqs, exc: Exception) -> None:
@@ -489,6 +686,7 @@ class Server:
         kind = reqs[0].kind
         budget = self.config.retry_budget
         retry = []
+        poisoned = []
         for r in reqs:
             r.attempts += 1
             if r.attempts >= budget:
@@ -497,8 +695,23 @@ class Server:
                     obs.count("serve.requests", kind=kind,
                               status="error")
                     obs.count("serve.poison.isolated", kind=kind)
+                    if r.trace is not None:
+                        r.trace.finish(status="poisoned",
+                                       stage="settle")
+                    poisoned.append(r.rid)
             else:
                 retry.append(r)
+        if poisoned:
+            # the poisoned batch's stage events are still in the ring:
+            # snapshot NOW so the post-mortem holds them (one dump per
+            # recover call, rate-limited inside the recorder) — and
+            # BEFORE the SLO accounting, whose own breach dump would
+            # otherwise rate-limit this one away
+            self._flight_dump(
+                "poisoned", query=kind, rids=poisoned, error=repr(exc)
+            )
+            for _rid in poisoned:
+                self._slo_bad(kind)
         if not retry:
             return
         _bump(self._retried, kind, len(retry))
@@ -560,6 +773,7 @@ class Server:
                     "serve.worker.errors", exc_type=type(e).__name__
                 )
                 obs.gauge("serve.worker.backoff_s", self._backoff_s)
+                self._flight_dump("worker_error", error=repr(e))
                 traceback.print_exc(file=sys.stderr)
                 time.sleep(self._backoff_s)
                 self._backoff_s = min(
@@ -671,6 +885,11 @@ class Server:
             lane_widths=list(self.config.lane_widths),
             max_queue=self.config.max_queue,
             updates=self._update_stats(),
+            slo=self.slo.describe() if self.slo is not None else None,
+            flightrec=(
+                self._recorder.describe()
+                if self._recorder is not None else None
+            ),
         )
         obs.gauge("serve.batches", self.batches)
         return s
@@ -717,6 +936,7 @@ class Server:
         worker_alive = (
             self._worker is not None and self._worker.is_alive()
         )
+        slo = self.slo.describe(now) if self.slo is not None else None
         closed = self.scheduler.closed
         if closed:
             status = "closed"
@@ -725,11 +945,20 @@ class Server:
             # nothing drains
         elif any(b["state"] != "closed" for b in breakers.values()):
             status = "degraded"
+        elif slo is not None and slo["breached"]:
+            # the SLO budget is burned through: everything still
+            # serves, but the tenant's contract is being violated
+            status = "degraded"
         else:
             status = "ok"
         return {
             "status": status,
             "tenant": self.tenant,
+            "slo": slo,
+            "flightrec_last_dump": (
+                self._recorder.last_dump
+                if self._recorder is not None else None
+            ),
             "worker_alive": worker_alive,
             "closed": closed,
             "queue_depth": self.scheduler.depth(),

@@ -42,6 +42,8 @@ def expire(req: "Request", where: str, on_timeout=None) -> bool:
         f"request {req.rid} ({req.kind} root={req.root}) {where}"
     )):
         obs.count("serve.requests", kind=req.kind, status="timeout")
+        if req.trace is not None:
+            req.trace.finish(status="timeout", stage="expired")
         if on_timeout is not None:
             on_timeout(req)
         return True
@@ -75,6 +77,7 @@ class Request:
     submitted_at: float
     deadline: float | None = None  # absolute; None = no timeout
     attempts: int = 0  # FAILING executions ridden (retry-budget meter)
+    trace: object = None  # sampled obs.trace.RequestTrace, or None
 
     def expired(self, now: float) -> bool:
         return self.deadline is not None and now >= self.deadline
@@ -122,7 +125,8 @@ def assemble(requests: list[Request], widths: tuple[int, ...],
 
 
 def scatter(requests: list[Request], result: dict,
-            now: float | None = None, on_timeout=None) -> int:
+            now: float | None = None, on_timeout=None,
+            on_ok=None, on_error=None) -> int:
     """Hand each request its own lane of ``result`` (the engine's
     column-sliced output dict). Pad lanes are never touched: iteration
     is over the request list (lane k belongs to requests[k]); the
@@ -130,7 +134,11 @@ def scatter(requests: list[Request], result: dict,
     already settled (timeout/cancel) are skipped; a request that
     expired DURING execution is timed out here (``on_timeout(req)``,
     when given, lets the server keep its per-kind accounting in step
-    with the obs counter). Returns the number of futures completed."""
+    with the obs counter; ``on_ok(req)``/``on_error(req)`` are the
+    success- and lane-error-side twins — the SLO budget's good/bad
+    hooks, so a per-lane scatter failure burns the budget like any
+    other user-visible error). Returns the number of futures
+    completed."""
     now = time.monotonic() if now is None else now
     done = 0
     for k, req in enumerate(requests):
@@ -157,9 +165,19 @@ def scatter(requests: list[Request], result: dict,
                     "serve.request.latency_s", now - req.submitted_at,
                     kind=req.kind,
                 )
+                if req.trace is not None:
+                    # the scatter stage closes the sampled trace: its
+                    # stage sum now telescopes to the e2e latency
+                    req.trace.finish(status="ok", stage="scatter")
+                if on_ok is not None:
+                    on_ok(req)
         except Exception as e:  # isolate: one bad lane never kills peers
             settle(req.future, exc=e)
             obs.count("serve.requests", kind=req.kind, status="error")
+            if req.trace is not None:
+                req.trace.finish(status="error", stage="scatter")
+            if on_error is not None:
+                on_error(req)
     return done
 
 

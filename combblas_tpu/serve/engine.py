@@ -322,6 +322,10 @@ class GraphEngine:
         # whole-graph analytics cache for refresh(): (kind, root) ->
         # {vid, result, niter} — the warm-restart recompute's memory
         self._analytics: dict = {}
+        # refresh-mode history (cached/warm/cold counts): the
+        # freshness surface (repair-vs-cold ratio) stats() reports and
+        # dynamic.refresh emits as gauges
+        self._refresh_modes: dict[str, int] = {}
         # ONE execution stream: plan building, warmup, and execute all
         # serialize here, so a caller-thread warmup() cannot race the
         # api worker's pump() on the plan cache (or the device)
@@ -965,13 +969,31 @@ class GraphEngine:
                 for (k, w), p in sorted(self._plans.items())
             }
             hits, misses = self.plan_hits, self.plan_misses
+        warm = self._refresh_modes.get("warm", 0)
+        cold = self._refresh_modes.get("cold", 0)
+        vid = self._version.vid
         return {
             "plans": plans,
             "plan_hits": hits,
             "plan_misses": misses,
             "nrows": self.nrows,
             "kinds": list(self.kinds()),
-            "graph_version": self._version.vid,
+            "graph_version": vid,
             "graph_nnz": self._version.nnz,
             "swaps": self.swaps,
+            # dynamic-lane freshness (round 15): how stale the cached
+            # analytics are vs the served version, and how often a
+            # refresh repaired instead of recomputing cold
+            "freshness": {
+                "refresh_modes": dict(self._refresh_modes),
+                "repair_ratio": (
+                    warm / (warm + cold) if warm + cold else None
+                ),
+                "versions_behind": (
+                    max(
+                        (vid - e["vid"] for e in self._analytics.values()),
+                        default=0,
+                    )
+                ),
+            },
         }

@@ -81,7 +81,16 @@ class FleetRouter:
         self.submitted: list[int] = [0] * len(self.replicas)
         self.spillovers = 0
         self.fanouts = 0
+        self._scrape = None  # obs.export.ScrapeServer (serve_metrics)
         obs.gauge("serve.fleet.replicas", len(self.replicas))
+
+    def serve_metrics(self, port: int = 0, host: str = "127.0.0.1"
+                      ) -> int:
+        """Attach the fleet's live scrape surface (/metrics, /healthz,
+        /statz — see ``Server.serve_metrics``); stopped by close()."""
+        from ..obs import export
+
+        return export.attach_scrape(self, port=port, host=host)
 
     # -- construction ------------------------------------------------------
 
@@ -225,8 +234,15 @@ class FleetRouter:
                 settle(outer, exc=exc)
                 return
             payload = dict(f.result())
+            # the home server's write-lane trace rides on the inner
+            # future; this callback runs INSIDE its settle (before the
+            # trace is finished), so a fan-out mark lands in the
+            # committed record between the swap and settle stages
+            tr = getattr(f, "_combblas_trace", None)
             try:
                 payload["fanned_out"] = self.fan_out()
+                if tr is not None:
+                    tr.mark("fanout")
             except Exception as e:  # the home merge LANDED; a failed
                 # fan-out is a divergence the caller must see
                 settle(outer, exc=e)
@@ -283,6 +299,10 @@ class FleetRouter:
     def close(self, drain: bool = True, timeout: float = 30.0) -> None:
         for srv in self.replicas:
             srv.close(drain=drain, timeout=timeout)
+        if self._scrape is not None:
+            from ..obs import export
+
+            export.detach_scrape(self)
 
     def __enter__(self) -> "FleetRouter":
         for srv in self.replicas:
@@ -313,8 +333,16 @@ class FleetRouter:
             status = "degraded"  # something still serves
         else:
             status = "down"
+        burns = {
+            i: h["slo"]["burn"]
+            for i, h in per.items() if h.get("slo") is not None
+        }
         return {
             "status": status,
             "replicas": per,
             "home": self.home,
+            # fleet-wide SLO budget burn (round 15): worst replica —
+            # the pageable number when replicas share one SLO
+            "slo_burn": burns,
+            "slo_burn_worst": max(burns.values()) if burns else None,
         }

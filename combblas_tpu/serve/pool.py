@@ -170,6 +170,13 @@ class EnginePool:
             t.server._stop_mutator(drain=False, timeout=5.0)
             t.engine = None
             t.server.engine = None
+        if t is not None:
+            # label-space hygiene (round 15): the removed tenant's
+            # labeled registry series (queue depth, requests, breaker,
+            # WFQ, pool counters) must not live — in memory AND on the
+            # scrape surface — forever; the WFQ state prunes in the
+            # pump, the registry prunes here, at the churn point
+            obs.prune_labels(tenant=name)
         self._gauge_residency()
 
     def tenant_names(self) -> list[str]:
@@ -451,6 +458,15 @@ class PoolServer:
         self._closed = False
         self.worker_errors = 0
         self.last_worker_error: Exception | None = None
+        self._scrape = None  # obs.export.ScrapeServer (serve_metrics)
+
+    def serve_metrics(self, port: int = 0, host: str = "127.0.0.1"
+                      ) -> int:
+        """Attach the pool's live scrape surface (/metrics, /healthz,
+        /statz — see ``Server.serve_metrics``); stopped by close()."""
+        from ..obs import export
+
+        return export.attach_scrape(self, port=port, host=host)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -498,6 +514,10 @@ class PoolServer:
             t = self.pool._peek(name)
             if t is not None and t.server is not None:
                 t.server.close(drain=False, timeout=timeout)
+        if self._scrape is not None:
+            from ..obs import export
+
+            export.detach_scrape(self)
 
     def __enter__(self) -> "PoolServer":
         return self.start()
@@ -582,7 +602,10 @@ class PoolServer:
         pool = self.pool
         now = time.monotonic()
         names = pool.tenant_names()
-        self.wfq.prune(names)  # tenant churn must not leak WFQ state
+        # tenant churn must not leak WFQ state — nor the dead names'
+        # obs label space (prune() returns what it dropped)
+        for gone in self.wfq.prune(names):
+            obs.prune_labels(tenant=gone)
         backlogged = []
         for name in names:
             t = pool._peek(name)
@@ -741,6 +764,7 @@ class PoolServer:
         with each tenant's breaker states labeled by tenant."""
         now = time.monotonic()
         breakers = {}
+        slo_burn = {}
         degraded = False
         for name in self.pool.tenant_names():
             t = self.pool._peek(name)
@@ -754,6 +778,11 @@ class PoolServer:
             breakers[name] = b
             if any(x["state"] != "closed" for x in b.values()):
                 degraded = True
+            if srv.slo is not None:
+                d = srv.slo.describe(now)
+                slo_burn[name] = d["burn"]
+                if d["breached"]:
+                    degraded = True
         if self._closed:
             status = "closed"
         elif self._worker is not None and not self._worker.is_alive():
@@ -769,6 +798,10 @@ class PoolServer:
             ),
             "closed": self._closed,
             "breakers": breakers,
+            # per-tenant SLO budget burn (round 15) — the one number a
+            # pool dashboard pages on, worst tenant first
+            "slo_burn": slo_burn,
+            "slo_burn_worst": max(slo_burn.values()) if slo_burn else None,
             "resident_bytes": self.pool.resident_bytes(),
             "byte_budget": self.pool.byte_budget,
             "worker_errors": self.worker_errors,

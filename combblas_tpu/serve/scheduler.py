@@ -192,7 +192,10 @@ class CircuitBreaker:
             # healthy path (one record_success per batch) stays free
             obs.gauge("serve.breaker.state", 0, **self._lab(kind))
 
-    def record_failure(self, now: float, kind: str = "") -> None:
+    def record_failure(self, now: float, kind: str = "") -> bool:
+        """Record one top-level batch failure.  Returns True exactly
+        when THIS call transitioned the breaker to OPEN — the flight
+        recorder's ``breaker_open`` dump trigger."""
         opened = False  # did THIS call transition to OPEN?
         with self._lock:
             if self.state == BREAKER_HALF_OPEN:
@@ -220,6 +223,7 @@ class CircuitBreaker:
                   **self._lab(kind))
         if opened:
             obs.count("serve.breaker.opened", **self._lab(kind))
+        return opened
 
     def describe(self, now: float) -> dict:
         with self._lock:
@@ -298,6 +302,21 @@ class ServeConfig:
     # occupying a lane late.  Both ``None`` (default) = disabled.
     slo_queue_budget: int | None = None
     slo_deadline_s: float | None = None
+    # -- production observability (round 15; docs/observability.md
+    # "Serving observability").  ``slo_target``/``slo_window_s``
+    # parameterize the rolling-window error budget built whenever
+    # ``slo_deadline_s`` is set (``serve/slo.py``).
+    # ``flight_recorder`` keeps a bounded always-on ring of per-batch
+    # stage events (``obs/recorder.py``) dumped as a schema-versioned
+    # JSONL snapshot on worker error / breaker open / poisoned batch /
+    # merge failure / SLO breach; False = the zero-cost opt-out (one
+    # attribute read on the batch path).
+    slo_target: float = 0.999
+    slo_window_s: float = 60.0
+    flight_recorder: bool = True
+    flight_recorder_events: int = 256
+    flight_recorder_dir: str | None = None
+    flight_recorder_min_interval_s: float = 1.0
 
     def __post_init__(self):
         if (
@@ -331,6 +350,16 @@ class ServeConfig:
             raise ValueError("slo_queue_budget must be >= 1")
         if self.slo_deadline_s is not None and self.slo_deadline_s <= 0:
             raise ValueError("slo_deadline_s must be > 0")
+        if not (0.0 < self.slo_target < 1.0):
+            raise ValueError("slo_target must be in (0, 1)")
+        if self.slo_window_s <= 0:
+            raise ValueError("slo_window_s must be > 0")
+        if self.flight_recorder_events < 1:
+            raise ValueError("flight_recorder_events must be >= 1")
+        if self.flight_recorder_min_interval_s < 0:
+            raise ValueError(
+                "flight_recorder_min_interval_s must be >= 0"
+            )
 
     def wait_for(self, kind: str) -> float:
         if self.per_kind_max_wait and kind in self.per_kind_max_wait:
@@ -356,6 +385,20 @@ class Scheduler:
         self._rid = itertools.count()
         self._lock = threading.Lock()
         self._closed = False
+        #: Shared ``serve.slo.ErrorBudget`` (assigned by the owning
+        #: Server when ``config.slo_deadline_s`` is set): the queue
+        #: sweep and the rejection paths record BAD dispositions here
+        #: so the budget sees every user-visible failure, not just the
+        #: executed ones.  None = no SLO accounting (one attribute
+        #: read per site).
+        self.slo = None
+        #: Breach hook (assigned alongside ``slo``): called with the
+        #: kind when a scheduler-side bad record BURNS THROUGH the
+        #: budget — record() fires the transition exactly once per
+        #: breach episode, so dropping its return here would swallow
+        #: the flight-recorder dump whenever the crossing lands on a
+        #: rejection/sweep instead of an execution failure.
+        self.slo_breach = None
         self.rejected = 0  # backpressure only; breakers count separately
         self.submitted = 0
         # per-kind disposition counters (Server.stats()'s per_kind
@@ -385,6 +428,15 @@ class Scheduler:
         if self.tenant is not None:
             labels["tenant"] = self.tenant
         return labels
+
+    def _slo_bad(self, kind: str) -> None:
+        """One scheduler-side bad SLO disposition; a budget-burn
+        crossing fires the owning Server's breach hook (the
+        flight-recorder dump — record() returns the transition exactly
+        once per episode, so it must not be dropped here)."""
+        if self.slo is not None and self.slo.record(False, kind=kind):
+            if self.slo_breach is not None:
+                self.slo_breach(kind)
 
     def close(self) -> None:
         """Refuse all further admissions, PERMANENTLY (set under the
@@ -461,6 +513,9 @@ class Scheduler:
             with self._lock:
                 _bump(self.breaker_rejected_kind, kind)
             obs.count("serve.breaker.fast_fail", **self._lab(kind=kind))
+            # a fast-failed request is a user-visible failure under an
+            # SLO (breach transitions reach the recorder via the hook)
+            self._slo_bad(kind)
             raise CircuitBreakerOpen(
                 kind, breaker.retry_after(now), tenant=self.tenant
             )
@@ -488,15 +543,30 @@ class Scheduler:
                     rid=next(self._rid), kind=kind, root=root_i,
                     future=fut, submitted_at=now, deadline=deadline,
                 )
+                # deterministic-sampled per-request trace (round 15):
+                # attached BEFORE the request becomes poppable — a
+                # post-append attach could race the worker, whose pop
+                # would then miss the early stage marks (or finish
+                # before the trace exists, leaking it uncommitted).
+                # Inside the admission lock only on success, so a
+                # rejected submit never allocates one; obs.request_
+                # trace is host-dict work (the queue-depth gauge below
+                # sets the in-lock precedent), disabled obs = one call
+                # + flag check.
+                req.trace = obs.request_trace(
+                    req.rid, kind=kind, tenant=self.tenant
+                )
                 self._pending[kind].append(req)
                 self.submitted += 1
                 obs.gauge("serve.queue.depth", d + 1, **self._lab())
-        except (BackpressureError, RuntimeError):
+        except (BackpressureError, RuntimeError) as e:
             if breaker is not None:
                 # this submit may have claimed the half-open probe
                 # slot in admit() above; it never entered the queue,
                 # so give the slot back (no-op unless half-open)
                 breaker.release_probe()
+            if isinstance(e, BackpressureError):
+                self._slo_bad(kind)
             raise
         return fut
 
@@ -615,7 +685,8 @@ class Scheduler:
                     _bump(self.timeout_kind, req.kind)
         for req in timed_out:  # settle OUTSIDE the lock (see above;
             # the per-kind bump already happened under it)
-            expire(req, "expired in queue")
+            if expire(req, "expired in queue"):
+                self._slo_bad(req.kind)
         return out
 
     def drain(self) -> list[list[Request]]:
@@ -633,6 +704,10 @@ class Scheduler:
                     drained.append(q.popleft())
         for req in drained:
             settle(req.future, exc=exc)
+            if req.trace is not None:  # abandoned reads still close
+                # their sampled trace (the write lane's _stop_mutator
+                # convention) — sampled==committed+dropped must hold
+                req.trace.finish(status="aborted", stage="settle")
 
 
 class DeficitRoundRobin:
@@ -688,17 +763,22 @@ class DeficitRoundRobin:
             self._deficit.pop(tenant, None)
             self.served.pop(tenant, None)
 
-    def prune(self, live) -> None:
+    def prune(self, live) -> list[str]:
         """Drop every tenant NOT in ``live`` (the pool pump calls this
         with the current tenant list): add/remove churn must not leak
         weights/deficit/served entries — or their obs label space —
-        for dead tenant names forever."""
+        for dead tenant names forever.  Returns the pruned names so
+        the caller can prune the metrics registry's ``tenant=`` label
+        space in the same breath (``obs.prune_labels``)."""
         live = set(live)
+        removed = []
         with self._lock:
             for t in [x for x in self._weights if x not in live]:
                 self._weights.pop(t, None)
                 self._deficit.pop(t, None)
                 self.served.pop(t, None)
+                removed.append(t)
+        return removed
 
     def set_weight(self, tenant: str, weight: float) -> None:
         self.add(tenant, weight)

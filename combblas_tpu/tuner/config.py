@@ -81,6 +81,13 @@ ENV_POOL_BYTE_BUDGET = "COMBBLAS_POOL_BYTE_BUDGET"
 ENV_POOL_QUANTUM = "COMBBLAS_POOL_QUANTUM"
 ENV_FLEET_REPLICAS = "COMBBLAS_FLEET_REPLICAS"
 
+#: Round-15 knob: deterministic per-request trace sampling rate for the
+#: serve path (``obs/trace.py``).  A request is traced iff obs is
+#: enabled AND ``crc32(request id) mod 1e6 < rate * 1e6`` — same ids +
+#: same rate = same sampled set on every replica.  Unset/empty/0 = no
+#: tracing (the zero-cost default).
+ENV_OBS_TRACE_SAMPLE = "COMBBLAS_OBS_TRACE_SAMPLE"
+
 #: Round-13 knob: the SpGEMM combine-merge tier (sort | runs | hash) —
 #: how partial-product pieces (3D fiber pieces, 2D ESC stage chunks)
 #: fold into one compacted tile.  Resolution: arg > plan-store record
@@ -281,6 +288,15 @@ def fleet_replicas(given: int | None = None) -> int:
         return max(int(given), 1)
     v = _int_env(ENV_FLEET_REPLICAS)
     return DEFAULT_FLEET_REPLICAS if v is None else max(v, 1)
+
+
+def obs_trace_sample(given: float | None = None) -> float:
+    """Per-request trace sampling rate in [0, 1]: explicit argument >
+    ``COMBBLAS_OBS_TRACE_SAMPLE`` > 0 (off).  Clamped to [0, 1]."""
+    if given is None:
+        v = os.environ.get(ENV_OBS_TRACE_SAMPLE)
+        given = float(v) if v else 0.0
+    return min(max(float(given), 0.0), 1.0)
 
 
 def dynamic_spill_frac() -> float:

@@ -39,6 +39,13 @@ os.environ["COMBBLAS_POOL_BYTE_BUDGET"] = "0"
 os.environ["COMBBLAS_POOL_QUANTUM"] = "0"
 os.environ["COMBBLAS_FLEET_REPLICAS"] = "0"
 
+# Hermetic trace sampling (round 15): an ambient
+# COMBBLAS_OBS_TRACE_SAMPLE would make every obs-enabled serve test
+# also record per-request traces (and their ``serve.trace.sampled``
+# counters would perturb the zero-bookkeeping gates); tests that
+# exercise tracing call obs.trace.set_sample_rate explicitly.
+os.environ["COMBBLAS_OBS_TRACE_SAMPLE"] = "0"
+
 import jax
 
 jax.config.update("jax_platforms", "cpu")

@@ -557,6 +557,16 @@ def test_obs_smoke_bench_trace_matches_schema(tmp_path):
     assert "compile_cache.misses" in agg["counters"]
     # BFS lru-cache gauges exported via the provider
     assert any(k.startswith("cache.bfs.") for k in agg["gauges"])
+    # round 15: the serve-path request traces ride in the same dump —
+    # the smallest end-to-end latency-decomposition trace
+    traces = [r for r in recs if r["kind"] == "trace"]
+    assert traces and all(
+        r["name"] == "serve.request" for r in traces
+    )
+    for r in traces:
+        assert abs(
+            sum(st["s"] for st in r["stages"]) - r["wall_s"]
+        ) < 1e-6
 
 
 def test_round11_dynamic_counters_gated(rng):

@@ -1,0 +1,72 @@
+"""Static catalog-drift sweep (ISSUE 13 satellite): every literal
+``obs.count / obs.gauge / obs.observe`` series name in the package must
+be cataloged in ``obs/metrics.py``'s docstring.
+
+The catalog stayed honest by convention since PR 1; this test makes it
+structural — a new series landing without a catalog row fails tier-1.
+Dynamically-built names (``obs.observe("k1." + stage)``) surface as a
+prefix ending in ``.`` and are matched as substrings of their
+cataloged ``prefix.*`` row.
+"""
+
+import os
+import re
+
+import combblas_tpu
+from combblas_tpu.obs import metrics as obs_metrics
+
+PKG_ROOT = os.path.dirname(os.path.abspath(combblas_tpu.__file__))
+
+#: Literal first-argument series names at obs writer call sites; the
+#: name may sit on the call line or a continuation (re.DOTALL-free:
+#: \s* crosses newlines on its own).
+_CALL = re.compile(
+    r"""obs\.(?:count|gauge|observe)\(\s*["']([A-Za-z0-9_.]+)["']"""
+)
+
+
+def _package_series_names() -> dict[str, list[str]]:
+    names: dict[str, list[str]] = {}
+    for dirpath, _dirs, files in os.walk(PKG_ROOT):
+        if "__pycache__" in dirpath:
+            continue
+        for fn in files:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            with open(path, encoding="utf-8") as f:
+                src = f.read()
+            rel = os.path.relpath(path, PKG_ROOT)
+            for m in _CALL.finditer(src):
+                names.setdefault(m.group(1), []).append(rel)
+    return names
+
+
+def test_every_emitted_series_is_cataloged():
+    catalog = open(obs_metrics.__file__, encoding="utf-8").read()
+    names = _package_series_names()
+    assert len(names) > 100  # the sweep actually swept the package
+    missing = sorted(
+        f"{name}  (emitted by {sorted(set(files))})"
+        for name, files in names.items()
+        if name not in catalog
+    )
+    assert not missing, (
+        "series emitted but not cataloged in obs/metrics.py — add a "
+        "catalog row (name + kind + meaning):\n" + "\n".join(missing)
+    )
+
+
+def test_known_series_are_swept():
+    """The sweep regex sees through the repo's call styles: same-line
+    literals, continuation-line literals, and **label splats."""
+    names = _package_series_names()
+    for expected in (
+        "serve.requests",            # **self._lab(...) splat style
+        "serve.update.failed",       # continuation-line literal
+        "dynamic.freshness.versions_behind",  # round 15
+        "serve.flightrec.dumps",     # round 15
+        "serve.slo.budget_burn",     # round 15
+        "serve.pool.admits",
+    ):
+        assert expected in names, expected
