@@ -68,6 +68,18 @@ Emits the standard ``{summary, metric, value, median, warning, rc}``
 final stdout line + BENCH_SUMMARY.json (with a per-tenant breakdown)
 itself, so a standalone run honors the bench headline contract;
 results are archived under benchmarks/results/r14/.
+
+BENCH_SERVE_RECOVERY=1 runs the DURABILITY/SELF-HEALING scenario
+(ISSUE 14): a ``BENCH_FLEET_REPLICAS``-wide (default 3) durable
+``FleetRouter`` (write-ahead log + background checkpointer in a temp
+dir) serves a mixed read/write stream while replica workers are KILLED
+mid-stream — a non-home replica first, then the HOME itself (forcing a
+promotion at the WAL's seqno frontier) — with the supervisor healing
+continuously.  Gates: availability >= 95% of reads, ZERO acknowledged
+writes lost (every acked edge present in the crash-recovered state),
+recovered state bit-exact (``recover_version`` vs the surviving home,
+``to_host_coo`` equal), and 0 post-recovery retraces across the healed
+fleet.  Results under benchmarks/results/r16/.
 """
 
 from __future__ import annotations
@@ -915,6 +927,198 @@ def run_pool(scale: int = SCALE, edgefactor: int = EDGEFACTOR,
     return out
 
 
+def run_recovery(scale: int = SCALE, edgefactor: int = EDGEFACTOR,
+                 grid_shape=(2, 4), kinds=("bfs", "pagerank")) -> dict:
+    """BENCH_SERVE_RECOVERY=1 — replica kills (home included)
+    mid-stream under mixed read/write load, healed live by the
+    supervisor; see the module docstring for the four gates."""
+    import tempfile
+    import threading
+
+    import numpy as np
+
+    from combblas_tpu import obs
+    from combblas_tpu.dynamic import open_wal, recover_version
+    from combblas_tpu.parallel.grid import Grid
+    from combblas_tpu.serve import FleetRouter, ServeConfig
+
+    sidecar = obs.enable_sidecar("serve-recovery")
+    nreplicas = max(int(os.environ.get("BENCH_FLEET_REPLICAS", "3")), 2)
+    nqueries = int(os.environ.get("BENCH_SERVE_QUERIES", "400"))
+    nwrites = int(os.environ.get("BENCH_RECOVERY_WRITES", "24"))
+    wal_dir = tempfile.mkdtemp(prefix="combblas-recovery-wal-")
+
+    n = 1 << scale
+    from combblas_tpu.utils.rmat import rmat_symmetric_coo_host
+
+    rows, cols = rmat_symmetric_coo_host(42, scale, edgefactor)
+    grid = Grid.make(*grid_shape)
+    deg = np.bincount(rows, minlength=n)
+    rng = np.random.default_rng(7)
+    roots = rng.choice(np.flatnonzero(deg > 0), size=nqueries)
+    stream = [
+        (kinds[i % len(kinds)], int(r)) for i, r in enumerate(roots)
+    ]
+    # churn pairs absent from the graph (insert-only writes keep the
+    # acked-edge-survives check exact)
+    present = set(zip(rows.tolist(), cols.tolist()))
+    pool = rng.permutation(n).tolist()
+    pairs = []
+    for a, b in zip(pool[0::2], pool[1::2]):
+        if a != b and (a, b) not in present and (b, a) not in present:
+            pairs.append((int(a), int(b)))
+        if len(pairs) >= nwrites:
+            break
+
+    cfg = ServeConfig(
+        lane_widths=(1, 2, 4, 8, 16),
+        max_queue=max(64, nqueries), max_wait_s=0.005,
+        update_flush=2, update_max_delay_s=0.01,
+    )
+    t0 = time.perf_counter()
+    fr = FleetRouter.build(
+        grid, rows, cols, n, replicas=nreplicas, config=cfg,
+        kinds=kinds, wal_dir=wal_dir,
+    )
+    load_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    fr.warmup()
+    warmup_s = time.perf_counter() - t0
+    fr.start_supervisor(interval_s=0.02)
+
+    acked: list = []
+    write_failures = 0
+
+    def writer():
+        nonlocal write_failures
+        for a, b in pairs:
+            try:
+                fr.submit_update(
+                    [("insert", a, b), ("insert", b, a)]
+                ).result(timeout=120)
+                acked.append((a, b))
+            except Exception:
+                # a write rejected / failed at a kill boundary was
+                # never CONFIRMED merged: it may still be durable
+                # (WAL-appended) — allowed, but not counted acked
+                write_failures += 1
+            time.sleep(0.002)
+
+    def kill(i):
+        fr.replicas[i].faults.script("replica.death", at=(0,))
+        try:
+            fr.replicas[i].submit("bfs", int(roots[0]))
+        except Exception:
+            pass
+
+    kills = {
+        nqueries // 3: lambda: kill((fr.home + 1) % nreplicas),
+        (2 * nqueries) // 3: lambda: kill(fr.home),  # THE promotion
+    }
+    ok = failed = 0
+    lat: list[float] = []
+    t0 = time.perf_counter()
+    wt = threading.Thread(target=writer)
+    wt.start()
+    for i, (kind, root) in enumerate(stream):
+        k = kills.get(i)
+        if k is not None:
+            k()
+        ts = time.monotonic()
+        try:
+            fr.submit(kind, root).result(timeout=120)
+            lat.append(time.monotonic() - ts)
+            ok += 1
+        except Exception:
+            failed += 1
+    wt.join(300)
+    wall_s = time.perf_counter() - t0
+    # let the supervisor finish healing the last kill: a quarantined
+    # slot is no longer _dead() but stays in _needs_rebuild until its
+    # replacement is actually re-admitted
+    deadline = time.monotonic() + 30
+    while (
+        fr._needs_rebuild
+        or any(fr._dead(i) for i in range(nreplicas))
+    ) and time.monotonic() < deadline:
+        time.sleep(0.02)
+    availability = ok / nqueries
+
+    # -- gate: 0 post-recovery retraces across the healed fleet ----------
+    marks = [s.engine.trace_mark() for s in fr.replicas]
+    for kind in kinds:
+        for srv in fr.replicas:
+            if srv.is_serving():
+                srv.submit(kind, int(roots[0])).result(timeout=120)
+    post_retraces = sum(
+        s.engine.retraces_since(m) for s, m in zip(fr.replicas, marks)
+    )
+    home_version = fr.replicas[fr.home].engine.version
+    stats = fr.stats()
+    fr.close(drain=True)
+
+    # -- gates: recovery bit-exact + zero acknowledged-write loss --------
+    wal = open_wal(wal_dir)
+    recovered = recover_version(wal_dir, wal, grid, kinds=kinds)
+    wal.close()
+    hr, hc, hv = home_version.E.to_host_coo()
+    rr, rc_, rv = recovered.E.to_host_coo()
+    bit_exact = (
+        np.array_equal(hr, rr) and np.array_equal(hc, rc_)
+        and np.array_equal(hv, rv)
+    )
+    have = set(zip(rr.tolist(), rc_.tolist()))
+    lost = [
+        p for p in acked
+        if p not in have or (p[1], p[0]) not in have
+    ]
+
+    out = {
+        "metric": "serve_recovery_availability",
+        "unit": "fraction_ok",
+        "value": round(availability, 4),
+        "availability_pct": round(100 * availability, 2),
+        "ok": bool(
+            availability >= 0.95
+            and not lost
+            and bit_exact
+            and post_retraces == 0
+            and stats["promotions"] >= 1
+            and stats["replacements"] >= 2  # both kills healed
+        ),
+        "nqueries": nqueries,
+        "reads_ok": ok,
+        "reads_failed": failed,
+        "read_retries": stats["read_retries"],
+        "writes_acked": len(acked),
+        "write_failures": write_failures,
+        "acked_writes_lost": len(lost),
+        "recovered_bit_exact": bit_exact,
+        "post_recovery_retraces": post_retraces,
+        "promotions": stats["promotions"],
+        "replacements": stats["replacements"],
+        "final_home": stats["home"],
+        "p50_ms": round(1e3 * _percentile(lat, 0.50), 2) if lat else None,
+        "p99_ms": round(1e3 * _percentile(lat, 0.99), 2) if lat else None,
+        "qps_under_kills": round(nqueries / wall_s, 2),
+        "recovered_nnz": int(len(rr)),
+        "replicas": nreplicas,
+        "scale": scale,
+        "grid": list(grid_shape),
+        "kinds": list(kinds),
+        "load_s": round(load_s, 2),
+        "warmup_s": round(warmup_s, 2),
+        "wal_dir": wal_dir,
+    }
+    obs.gauge("serve.bench.recovery_availability", availability)
+    if sidecar:
+        try:
+            out["obs_jsonl"] = obs.dump_jsonl()
+        except Exception as e:  # telemetry must never fail the bench
+            out["obs_error"] = str(e)
+    return out
+
+
 def _emit_pool_summary(out: dict) -> int:
     """The bench headline contract (bench.py ``emit_summary``) for the
     standalone pool scenario: a compact truncation-proof final stdout
@@ -958,6 +1162,8 @@ def main():
         out = run_chaos()
     elif os.environ.get("BENCH_SERVE_MUTATE") == "1":
         out = run_mutate()
+    elif os.environ.get("BENCH_SERVE_RECOVERY") == "1":
+        out = run_recovery()
     else:
         out = run()
     print(json.dumps(out), flush=True)

@@ -21,6 +21,13 @@ the WRITE half (the capability bar is the reference's in-place
    delta-frontier BFS/CC repair (re-expand only from the endpoints of
    changed edges; insert-only, by monotonicity) and PageRank restart
    from the previous vector, exposed as ``GraphEngine.refresh(kind)``.
+4. **wal** (`wal.py`, round 16) — the durability layer: a
+   schema-versioned append-only write-ahead log of acknowledged
+   ``submit_update`` batches (torn-tail tolerant, fsync-policy knob)
+   plus ``recover_version`` = latest valid ``utils.checkpoint``
+   snapshot + WAL-suffix replay through ``apply_delta``, bit-exact
+   with a never-crashed engine (docs/serving.md "Durability &
+   self-healing").
 
 ``serve.api.Server`` wires it into traffic: ``submit_update()`` admits
 mutations into the buffer, a dedicated mutation thread coalesces and
@@ -45,10 +52,19 @@ from .merge import (  # noqa: F401
     bootstrap_state,
 )
 from .refresh import REFRESH_KINDS, refresh_analytic  # noqa: F401
+from .wal import (  # noqa: F401
+    RecoveryError,
+    WriteAheadLog,
+    open_wal,
+    recover,
+    recover_version,
+)
 
 __all__ = [
     "DeltaBuffer", "DeltaBatch", "DeltaOverflowError", "OP_NAMES",
     "COMBINES", "fold_ops",
     "apply_delta", "bootstrap_state", "MergeState", "MergeStats",
     "refresh_analytic", "REFRESH_KINDS",
+    "WriteAheadLog", "open_wal", "recover", "recover_version",
+    "RecoveryError",
 ]

@@ -132,7 +132,8 @@ class DeltaBuffer:
     def __init__(self, capacity: int = 65536, *,
                  nrows: int | None = None, ncols: int | None = None,
                  combine: str = "min",
-                 retry_after_s: float = 0.05):
+                 retry_after_s: float = 0.05,
+                 start_seq: int = 0):
         if capacity < 1:
             raise ValueError("delta buffer capacity must be >= 1")
         if combine not in COMBINES:
@@ -149,7 +150,11 @@ class DeltaBuffer:
         self._cols: list[int] = []
         self._vals: list[float] = []
         self._ops: list[int] = []
-        self._next_seq = 0
+        # start_seq (round 16): a recovered / promoted server resumes
+        # the WAL's seqno lineage instead of restarting at 0 — replay
+        # dedup and snapshot stamps depend on sequence numbers being a
+        # single monotone line across process lives
+        self._next_seq = int(start_seq)
         self._oldest_at: float | None = None
         # host-side counters (always live; obs mirrors cost nothing
         # when telemetry is disabled)
@@ -233,6 +238,38 @@ class DeltaBuffer:
             obs.count("dynamic.delta.ops", op=op)
         obs.gauge("dynamic.delta.depth", depth)
         return last
+
+    def rollback(self, from_seq: int) -> int:
+        """Un-admit the TAIL of pending ops with sequence number >=
+        ``from_seq`` and rewind the sequence counter — the write
+        lane's WAL-append failure path (round 16): ops whose durable
+        record could not be written were never acknowledged, so they
+        must not merge.  Only a tail can be rolled back (earlier ops
+        may already be acknowledged); the caller must ensure no drain
+        ran in between (``Server.submit_update`` holds its admission
+        lock across append + rollback).  Returns ops removed."""
+        with self._lock:
+            first_pending = self._next_seq - len(self._rows)
+            if from_seq < first_pending:
+                raise ValueError(
+                    f"rollback(from_seq={from_seq}) reaches below the "
+                    f"pending tail (first pending seq {first_pending})"
+                    " — those ops were already drained/acknowledged"
+                )
+            n = self._next_seq - int(from_seq)
+            if n <= 0:
+                return 0
+            del self._rows[-n:]
+            del self._cols[-n:]
+            del self._vals[-n:]
+            del self._ops[-n:]
+            self._next_seq = int(from_seq)
+            self.admitted -= n
+            if not self._rows:
+                self._oldest_at = None
+            depth = len(self._rows)
+        obs.gauge("dynamic.delta.depth", depth)
+        return n
 
     # -- introspection -----------------------------------------------------
 

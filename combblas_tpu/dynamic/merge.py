@@ -122,6 +122,42 @@ class MergeState:
 # -- host structure builders -------------------------------------------------
 
 
+def _orientation_from_buckets(grid, buckets, major, minor,
+                              nrows: int, ncols: int) -> _Orientation:
+    """Assemble an ``_Orientation`` from host ``(bc, bv, br)`` bucket
+    triples + the layout's (major, minor) index arrays — the ONE place
+    the key encoding (``major * ncols + minor``), the fine ladder, and
+    the contiguous-bc/br invariants live (shared by fresh builds and
+    snapshot restores; drift between them silently corrupts merges)."""
+    from ..parallel.ellmat import _width_ladder
+
+    lr, lc = grid.local_rows(nrows), grid.local_cols(ncols)
+    max_k = max(int(lc), 1)
+    keys = np.sort(
+        np.asarray(major, np.int64) * np.int64(ncols)
+        + np.asarray(minor, np.int64)
+    )
+    return _Orientation(
+        keys=keys, nrows=int(nrows), ncols=int(ncols), lr=lr, lc=lc,
+        kbs=[int(bc.shape[-1]) for bc, _bv, _br in buckets],
+        bc=[np.ascontiguousarray(bc) for bc, _bv, _br in buckets],
+        br=[np.ascontiguousarray(br) for _bc, _bv, br in buckets],
+        ladder=_width_ladder(max_k, "fine"), max_k=max_k,
+    )
+
+
+def _is_symmetric(rows, cols, nrows: int, ncols: int) -> bool:
+    """Structural symmetry of a key-sorted deduped COO (the merge
+    state's bc-serving guard input)."""
+    rows = np.asarray(rows, np.int64)
+    cols = np.asarray(cols, np.int64)
+    keys = rows * np.int64(ncols) + cols
+    return bool(
+        int(nrows) == int(ncols)
+        and np.array_equal(np.sort(cols * np.int64(ncols) + rows), keys)
+    )
+
+
 def _build_orientation(grid, rows, cols, nrows: int, ncols: int,
                        headroom: float | None = None) -> _Orientation:
     """Host bucket structure for one layout — the SAME deterministic
@@ -129,25 +165,14 @@ def _build_orientation(grid, rows, cols, nrows: int, ncols: int,
     the headroom over-allocation: mismatched slack would change bucket
     shapes and forfeit untouched-class sharing), so untouched classes
     can be shared with the existing device arrays."""
-    from ..parallel.ellmat import EllParMat, _width_ladder
+    from ..parallel.ellmat import EllParMat
 
-    lr, lc = grid.local_rows(nrows), grid.local_cols(ncols)
-    max_k = max(int(lc), 1)
-    ladder = _width_ladder(max_k, "fine")
     buckets = EllParMat.host_build(
         grid, rows, cols, np.ones(len(rows), np.float32), nrows, ncols,
         headroom=headroom,
     )
-    keys = np.asarray(rows, np.int64) * np.int64(ncols) + np.asarray(
-        cols, np.int64
-    )
-    keys = np.sort(keys)
-    return _Orientation(
-        keys=keys, nrows=int(nrows), ncols=int(ncols), lr=lr, lc=lc,
-        kbs=[int(bc.shape[-1]) for bc, _bv, _br in buckets],
-        bc=[np.ascontiguousarray(bc) for bc, _bv, _br in buckets],
-        br=[np.ascontiguousarray(br) for _bc, _bv, br in buckets],
-        ladder=ladder, max_k=max_k,
+    return _orientation_from_buckets(
+        grid, buckets, rows, cols, nrows, ncols
     )
 
 
@@ -177,16 +202,58 @@ def bootstrap_state(version, grid=None) -> MergeState:
     weights = getattr(version, "host_weights", None)
     if weights is not None:
         weights = np.asarray(weights, np.float32)
-    keys = rows * np.int64(ncols) + cols
-    symmetric = bool(
-        nrows == ncols
-        and np.array_equal(np.sort(cols * np.int64(ncols) + rows), keys)
-    )
     return MergeState(
         row=row_o, t=t_o, weights=weights,
         deg=np.bincount(rows, minlength=nrows).astype(np.int32),
         outdeg=np.bincount(cols, minlength=ncols).astype(np.int64),
-        symmetric=symmetric,
+        symmetric=_is_symmetric(rows, cols, nrows, ncols),
+    )
+
+
+def state_from_host_buckets(grid, row_buckets, t_buckets, host_coo,
+                            host_weights, deg, outdeg) -> MergeState:
+    """Merge state from retained HOST bucket arrays — the snapshot-
+    restore path (round 16, ``utils.checkpoint.load_version``).
+
+    A snapshot of an incrementally merged version carries STICKY-SLOT
+    bucket layouts that a fresh ``host_build`` of the same edge list
+    would NOT reproduce (in-place patching deliberately never moves a
+    shrunk-then-regrown row) — so ``bootstrap_state``'s rebuild-from-
+    COO assumption breaks on restored versions: patching against the
+    wrong slot map corrupts the graph.  This constructor derives the
+    state from the snapshot's own host arrays instead — exactly the
+    device layout, no device reads (the axon D2H rule holds).
+
+    ``row_buckets`` / ``t_buckets`` are lists of host ``(bc, bv, br)``
+    triples in the E / ET layouts (``t_buckets=None`` for symmetric
+    versions); ``host_coo`` the retained ``(rows, cols, ncols)``.
+    """
+    rows, cols, ncols = host_coo
+    rows = np.asarray(rows, np.int64)
+    cols = np.asarray(cols, np.int64)
+    ncols = int(ncols)
+    nrows = int(len(deg))
+    row_o = _orientation_from_buckets(
+        grid, row_buckets, rows, cols, nrows, ncols
+    )
+    t_o = (
+        _orientation_from_buckets(
+            grid, t_buckets, cols, rows, ncols, nrows
+        )
+        if t_buckets is not None else None
+    )
+    return MergeState(
+        row=row_o, t=t_o,
+        weights=(
+            np.asarray(host_weights, np.float32)
+            if host_weights is not None else None
+        ),
+        deg=np.asarray(deg, np.int32),
+        outdeg=(
+            np.asarray(outdeg, np.int64) if outdeg is not None
+            else np.bincount(cols, minlength=ncols).astype(np.int64)
+        ),
+        symmetric=_is_symmetric(rows, cols, nrows, ncols),
     )
 
 
@@ -506,7 +573,20 @@ def apply_delta(version, batch: DeltaBatch, *,
     stats = MergeStats(mode="incremental")
     state = getattr(version, "dyn", None)
     if state is None:
-        state = bootstrap_state(version, grid=grid)
+        # snapshot-restored versions carry a LAZY state constructor
+        # (``dyn_source``, utils/checkpoint.load_version): the merge
+        # state must describe the restored sticky-slot bucket layout
+        # — bootstrap_state's fresh host_build would not reproduce it
+        src = getattr(version, "dyn_source", None)
+        if src is not None:
+            # the source stays on the parent (construction is
+            # idempotent): if THIS merge fails, a retry must rebuild
+            # the restored-layout state again — falling back to
+            # bootstrap_state's fresh host_build would patch the
+            # wrong slot map
+            state = src()
+        else:
+            state = bootstrap_state(version, grid=grid)
         stats.bootstrapped = True
         obs.count("dynamic.state.bootstrap")
     ncols = int(version.ncols)

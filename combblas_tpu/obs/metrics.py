@@ -657,6 +657,118 @@ name                                      kind       meaning
 ``obs.scrape.requests``                   counter    HTTP scrape hits
                                                      (labels ``path``)
 ========================================  =========  ==================
+
+Durability & self-healing series (round 16 — the write-ahead log,
+crash recovery, replica supervision and write-home failover;
+docs/serving.md "Durability & self-healing"):
+
+========================================  =========  ==================
+name                                      kind       meaning
+========================================  =========  ==================
+``serve.wal.appends``                     counter    WAL records
+                                                     durably appended
+                                                     (data records and
+                                                     drop tombstones;
+                                                     frontier marks
+                                                     are written by
+                                                     truncation, not
+                                                     counted here)
+``serve.wal.append_s``                    histogram  per-append latency
+                                                     (fsync included
+                                                     under policy
+                                                     ``always``)
+``serve.wal.append_failed``               counter    appends that
+                                                     failed — the
+                                                     write was
+                                                     REJECTED, never
+                                                     acknowledged
+                                                     undurable
+``serve.wal.invalid``                     counter    damaged JSONL
+                                                     lines skipped at
+                                                     replay (counted
+                                                     once per line;
+                                                     the expected
+                                                     torn-final-line
+                                                     crash artifact
+                                                     included)
+``serve.wal.truncated``                   counter    replayed-prefix
+                                                     records dropped
+                                                     by checkpoint
+                                                     truncation
+``serve.checkpoint.auto``                 counter    snapshots taken
+                                                     (labels
+                                                     ``reason`` =
+                                                     bootstrap / auto /
+                                                     close / manual)
+``serve.checkpoint.failed``               counter    failed snapshot
+                                                     attempts (labels
+                                                     ``exc_type``;
+                                                     previous snapshot
+                                                     + WAL stay
+                                                     intact)
+``serve.recovery.runs``                   counter    ``recover_version``
+                                                     completions
+``serve.recovery.replayed_ops``           counter    WAL ops replayed
+                                                     through
+                                                     ``apply_delta``
+                                                     during recovery
+``serve.recovery.recover_s``              histogram  snapshot-load +
+                                                     replay wall time
+``serve.recovery.snapshot_seq``           gauge      ``wal_seq`` stamp
+                                                     of the snapshot
+                                                     recovery loaded
+``serve.recovery.snapshot_rejected``      counter    corrupt/truncated
+                                                     snapshots skipped
+                                                     (fallback to the
+                                                     previous retained
+                                                     one)
+``serve.fleet.versions_behind``           gauge      fan-out
+                                                     generations a
+                                                     replica lags the
+                                                     home (labels
+                                                     ``replica``; > 0
+                                                     degrades fleet
+                                                     health)
+``serve.fleet.fanout_failed``             counter    per-replica
+                                                     rebuild/swap
+                                                     failures inside
+                                                     ``fan_out`` —
+                                                     the replica lags,
+                                                     the fleet
+                                                     continues (labels
+                                                     ``replica``)
+``serve.fleet.supervisor``                counter    supervision events
+                                                     (labels
+                                                     ``action`` =
+                                                     detected /
+                                                     replaced / error /
+                                                     warmup_error)
+``serve.fleet.promotions``                counter    home promotions at
+                                                     the WAL frontier
+``serve.fleet.replaced``                  counter    dead replicas
+                                                     rebuilt from
+                                                     checkpoint+WAL
+                                                     and re-admitted
+                                                     (labels
+                                                     ``replica``)
+``serve.fleet.quarantined``               counter    dead servers taken
+                                                     out of service,
+                                                     pending futures
+                                                     failed honestly
+``serve.fleet.read_retry``                counter    reads re-submitted
+                                                     to the next-best
+                                                     replica after an
+                                                     execution-side
+                                                     failure (labels
+                                                     ``replica`` — the
+                                                     retry target)
+``serve.fleet.drained`` /                 counter    rolling-restart
+``serve.fleet.restored`` /                           lifecycle events
+``serve.fleet.rolling_restarts``                     (labels
+                                                     ``replica`` on
+                                                     the per-replica
+                                                     pair)
+========================================  =========  ==================
 """
 
 from __future__ import annotations

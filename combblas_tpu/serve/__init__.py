@@ -35,11 +35,20 @@ batches". Four layers (docs/serving.md has the full architecture):
    rebuilds bit-exact), per-tenant breakers/SLOs/fault injectors, and
    one worker thread arbitrated by weighted deficit-round-robin
    (reads AND write merges charge the tenant's share).
-7. **fleet** (`fleet.py`, round 14) — ``FleetRouter``: N replica
+7. **fleet** (`fleet.py`, rounds 14/16) — ``FleetRouter``: N replica
    servers behind one front door sharing ONE warm plan store —
-   least-loaded routing with spillover, writes routed to a home
-   replica and fanned out through the atomic swap, warm starts from
-   ``utils.checkpoint.save_version`` GraphVersion snapshots.
+   least-loaded routing with spillover (dead/closed/draining replicas
+   attract no traffic), writes routed to a home replica and fanned
+   out through the atomic swap, warm starts from
+   ``utils.checkpoint.save_version`` GraphVersion snapshots; plus the
+   round-16 self-healing layer: a supervisor thread detecting dead
+   replica workers, quarantine (pending futures failed honestly),
+   rebuild-from-checkpoint+WAL replacement, home PROMOTION at the
+   write-ahead log's seqno frontier, ``drain``/``rolling_restart``,
+   and bounded read retry on the next-best replica.  The durability
+   substrate (``dynamic/wal.py`` WAL + ``Server``'s background
+   checkpointer + ``from_recovery``) is docs/serving.md "Durability &
+   self-healing".
 
 Everything is wired into ``combblas_tpu.obs`` (queue-depth gauge,
 occupancy/padding-waste/latency histograms, plan-cache and
@@ -60,13 +69,14 @@ from .scheduler import (
 )
 from .api import Server
 from .pool import EnginePool, PoolServer
-from .fleet import FleetRouter
+from .fleet import FleetRouter, ReplicaDeadError
 from .slo import ErrorBudget
 
 __all__ = [
     "GraphEngine", "GraphVersion", "Server", "ServeConfig", "Scheduler",
     "BackpressureError", "CircuitBreaker", "CircuitBreakerOpen",
     "DeficitRoundRobin", "EnginePool", "PoolServer", "FleetRouter",
+    "ReplicaDeadError",
     "FaultInjector", "InjectedFault", "FAULT_POINTS", "ErrorBudget",
     "Request", "KINDS",
     "bucket_width", "assemble", "scatter",

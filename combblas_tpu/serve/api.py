@@ -40,7 +40,7 @@ from concurrent.futures import Future
 from .. import obs
 from ..obs.recorder import FlightRecorder
 from . import batcher
-from .faults import FaultInjector
+from .faults import FaultInjector, InjectedFault
 from .scheduler import BackpressureError, Scheduler, ServeConfig, _bump
 from .slo import ErrorBudget
 
@@ -131,6 +131,29 @@ class Server:
         self.updates_invalid = 0
         self._merge_modes: dict[str, int] = {}
         self._merge_s: dict[str, float] = {}
+        # -- durability (round 16; docs/serving.md "Durability &
+        # self-healing"): the write-ahead log every acknowledged
+        # submit_update appends to BEFORE its future exists, and the
+        # background checkpointer that snapshots the served version
+        # (atomic tmp+rename, off the exec lock) and truncates the
+        # replayed WAL prefix.  ``_wal is None`` (the default — no
+        # ServeConfig.wal_dir / COMBBLAS_WAL) keeps every hot path at
+        # one attribute read.
+        self._wal = None
+        self._wal_frontier = -1  # highest seq APPENDED (acknowledged)
+        self._wal_applied = -1   # highest seq MERGED into the served
+        #                          version (external hot-swap versions
+        #                          are stamped here: pending appended
+        #                          ops merge on top of them later)
+        self._ckpt_dir: str | None = None
+        self._ckpt_cond = threading.Condition()
+        self._ckpt_lock = threading.Lock()  # one snapshot at a time
+        self._ckpt_thread: threading.Thread | None = None
+        self._ckpt_stop = False
+        self._merges_since_ckpt = 0
+        self.checkpoints = 0
+        self.checkpoint_failures = 0
+        self._attach_durability()
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -156,6 +179,7 @@ class Server:
                 target=self._loop, name="combblas-serve", daemon=True
             )
             self._worker.start()
+        self._start_checkpointer()
         return self
 
     def close(self, drain: bool = True, timeout: float = 30.0) -> None:
@@ -191,6 +215,18 @@ class Server:
         # graph, and the read drain above must run on one consistent
         # execution stream either way (the engine lock serializes)
         self._stop_mutator(drain, timeout)
+        # durability teardown (round 16): stop the checkpointer, take
+        # one final snapshot when merges landed since the last (a
+        # clean close leaves recovery with zero WAL to replay), and
+        # release the log handle
+        self._stop_checkpointer(timeout)
+        if drain and self._ckpt_dir is not None:
+            with self._ckpt_cond:
+                dirty = self._merges_since_ckpt > 0
+            if dirty:
+                self.checkpoint_now(reason="close")
+        if self._wal is not None:
+            self._wal.close()
         if self._scrape is not None:
             from ..obs import export
 
@@ -265,7 +301,307 @@ class Server:
             nrows=self.engine.nrows,
             ncols=int(self.engine.version.ncols),
             retry_after_s=self.config.update_max_delay_s,
+            # a durable server continues the WAL's seqno lineage —
+            # replay dedup and snapshot stamps need ONE monotone
+            # sequence line across process lives (round 16; the
+            # frontier also covers non-durable merges made before an
+            # attach_durability)
+            start_seq=(
+                self._wal_frontier + 1
+                if self._wal is not None else 0
+            ),
         )
+
+    # -- durability: WAL + background checkpointer (round 16) --------------
+
+    def attach_durability(self, dirpath: str) -> None:
+        """Attach the WAL + checkpointer to a RUNNING server — the
+        fleet's home-promotion path (round 16): the promoted replica
+        was built without durability (only the home owns the log) and
+        takes it over at the frontier.  Idempotent for the same dir;
+        a different dir raises (one log, one lineage)."""
+        import os
+
+        # the WHOLE attach runs under the write-admission lock: a
+        # submit_update racing the attach would otherwise re-create
+        # the buffer at seq 0 and acknowledge a write with no WAL
+        # record in the window between the depth check and the log
+        # opening (TOCTOU)
+        with self._upd_cond:
+            if self._wal is not None:
+                if self._ckpt_dir == os.path.abspath(dirpath):
+                    return
+                raise RuntimeError(
+                    f"server already durable at {self._ckpt_dir!r}; "
+                    f"refusing to switch to {dirpath!r}"
+                )
+            if (
+                self._upd_buffer is not None
+                and self._upd_buffer.depth()
+            ) or self._upd_futs:
+                # pre-attach buffered ops (and drained batches whose
+                # merge is still in flight — _merge_once runs outside
+                # this lock) carry non-lineage seqs: they would
+                # collide with the WAL's frontier numbering
+                raise RuntimeError(
+                    "cannot attach durability with un-merged buffered "
+                    "writes pending; drain them first"
+                )
+            self._upd_buffer = None  # recreate at the WAL frontier
+            self._attach_durability(dirpath)
+        if self._worker is not None and self._worker.is_alive():
+            self._start_checkpointer()
+
+    def _attach_durability(self, d: str | None = None) -> None:
+        """Attach the write-ahead log + checkpoint directory when
+        configured (``ServeConfig.wal_dir`` > ``COMBBLAS_WAL`` > off).
+        A server that was NOT booted from recovery writes a bootstrap
+        snapshot at the current WAL frontier — recovery is always
+        "latest snapshot + WAL suffix", so a base snapshot must exist
+        before the first write is acknowledged."""
+        import os
+
+        from ..tuner import config as tuner_config
+
+        if d is None:
+            d = tuner_config.wal_dir(self.config.wal_dir)
+        else:
+            d = os.path.abspath(d)  # idempotence compares abspaths
+        if d is None:
+            return
+        if self.engine.version.host_coo is None:
+            raise ValueError(
+                "durability (wal_dir) needs the host edge list: build "
+                "the engine with GraphEngine.from_coo(keep_coo=True) "
+                "or boot via Server.from_recovery"
+            )
+        from ..dynamic import wal as dyn_wal
+        from ..utils import checkpoint as ckpt
+
+        os.makedirs(d, exist_ok=True)
+        v = self.engine.version
+        wal = dyn_wal.open_wal(d, fsync=self.config.wal_fsync)
+        if getattr(v, "recovered_from", None) is None:
+            # boot-from-COO: the bootstrap snapshot below would
+            # truncate the WAL at the new frontier — REFUSE if that
+            # would destroy acknowledged writes no snapshot holds
+            # ("no acknowledged write is lost" is the whole contract)
+            snaps = ckpt.list_snapshots(d)
+            covered = ckpt.snapshot_seq(snaps[-1]) if snaps else -1
+            unreplayed = wal.replay(after_seq=covered)
+            if unreplayed:
+                wal.close()
+                raise RuntimeError(
+                    f"durability dir {d!r} holds "
+                    f"{sum(len(b) for b in unreplayed)} acknowledged "
+                    "write op(s) no snapshot covers; booting from a "
+                    "fresh COO here would silently destroy them — "
+                    "recover them (Server.from_recovery / "
+                    "FleetRouter.from_recovery) or point wal_dir at "
+                    "a fresh directory"
+                )
+        self._ckpt_dir = d
+        self._wal = wal
+        # the seqno frontier is the max over BOTH the log's position
+        # and the version's own stamp: a server that merged writes
+        # non-durably before attach_durability() must not restart
+        # sequence numbers below its snapshot stamp (later snapshots
+        # would sort before the bootstrap one and recovery would skip
+        # every post-attach record)
+        self._wal_frontier = max(self._wal.position(), int(v.wal_seq))
+        if v.wal_seq < self._wal_frontier:
+            # boot over an exhausted (fully snapshotted/replayed) log:
+            # this version DEFINES a fresh lineage at the frontier
+            v.wal_seq = self._wal_frontier
+        self._wal_applied = v.wal_seq
+        snaps = ckpt.list_snapshots(d)
+        covered = ckpt.snapshot_seq(snaps[-1]) if snaps else None
+        if covered is None or covered < v.wal_seq or (
+            getattr(v, "recovered_from", None) is None
+        ):
+            # the attached state must be recoverable NOW as "snapshot
+            # + suffix": fresh-COO boots always snapshot (they define
+            # the lineage), and a recovered version snapshots exactly
+            # when its replayed suffix outruns the newest snapshot
+            # (compacting the WAL as a side effect).  A bootstrap
+            # failure raises: durability was promised.
+            self.checkpoint_now(reason="bootstrap", _raise=True)
+
+    @property
+    def durable(self) -> bool:
+        return self._wal is not None
+
+    def checkpoint_now(self, reason: str = "manual",
+                       _raise: bool = False) -> dict | None:
+        """Snapshot the CURRENT served version (atomic tmp+rename,
+        off the execution lock — versions are immutable, so reading
+        one concurrently with serving is safe), truncate the WAL
+        prefix the snapshot now covers, and prune snapshots beyond the
+        retention depth.  Returns ``{"path", "wal_seq", "reason"}`` or
+        ``None`` (disabled / failed — a failed auto-checkpoint leaves
+        the previous snapshot and the un-truncated WAL intact and
+        retries on the next trigger)."""
+        import os
+
+        if self._ckpt_dir is None:
+            return None
+        from ..tuner import config as tuner_config
+        from ..utils import checkpoint as ckpt
+
+        v = self.engine.version
+        with self._ckpt_lock:
+            try:
+                self.faults.check(
+                    "checkpoint.save", seq=v.wal_seq, reason=reason
+                )
+                path = os.path.join(
+                    self._ckpt_dir, ckpt.snapshot_name(v.wal_seq)
+                )
+                ckpt.save_version(path, v)
+                with self._ckpt_cond:
+                    self._merges_since_ckpt = 0
+                self.checkpoints += 1
+                obs.count("serve.checkpoint.auto", reason=reason)
+                retain = tuner_config.checkpoint_retain(
+                    self.config.checkpoint_retain
+                )
+                for old in ckpt.list_snapshots(self._ckpt_dir)[:-retain]:
+                    try:
+                        os.unlink(old)
+                    except OSError:
+                        pass  # racing pruner / readonly: retried next
+                if self._wal is not None:
+                    # truncate only through the OLDEST retained
+                    # snapshot: the corrupt-newest fallback
+                    # (checkpoint_retain's whole purpose) needs the
+                    # WAL to still cover the predecessor→newest gap,
+                    # or falling back would silently lose that span
+                    snaps = ckpt.list_snapshots(self._ckpt_dir)
+                    self._wal.truncate(
+                        ckpt.snapshot_seq(snaps[0]) if snaps
+                        else v.wal_seq
+                    )
+                return {
+                    "path": path, "wal_seq": int(v.wal_seq),
+                    "reason": reason,
+                }
+            except Exception as e:
+                self.checkpoint_failures += 1
+                obs.count(
+                    "serve.checkpoint.failed",
+                    exc_type=type(e).__name__,
+                )
+                self._flight_dump("checkpoint_failed", error=repr(e))
+                if _raise:
+                    raise
+                return None
+
+    def _ckpt_note_merge(self) -> None:
+        if self._ckpt_dir is None:
+            return
+        with self._ckpt_cond:
+            self._merges_since_ckpt += 1
+            self._ckpt_cond.notify_all()
+
+    def _start_checkpointer(self) -> None:
+        if self._ckpt_dir is None:
+            return
+        if self._ckpt_thread is None or not self._ckpt_thread.is_alive():
+            self._ckpt_stop = False
+            self._ckpt_thread = threading.Thread(
+                target=self._ckpt_loop, name="combblas-serve-ckpt",
+                daemon=True,
+            )
+            self._ckpt_thread.start()
+
+    def _ckpt_loop(self) -> None:
+        from ..tuner import config as tuner_config
+
+        every = tuner_config.checkpoint_every(
+            self.config.checkpoint_every
+        )
+        interval = self.config.checkpoint_interval_s
+        last_t = time.monotonic()
+        backoff = self.config.worker_backoff_s
+        while True:
+            with self._ckpt_cond:
+                while not self._ckpt_stop:
+                    now = time.monotonic()
+                    if self._merges_since_ckpt >= every or (
+                        interval is not None
+                        and self._merges_since_ckpt > 0
+                        and now - last_t >= interval
+                    ):
+                        break
+                    if interval is None or self._merges_since_ckpt == 0:
+                        # nothing to snapshot until a merge lands —
+                        # block until _ckpt_note_merge (or stop)
+                        # notifies, never poll an idle server
+                        self._ckpt_cond.wait()
+                    else:
+                        self._ckpt_cond.wait(
+                            max(0.005, interval - (now - last_t))
+                        )
+                if self._ckpt_stop:
+                    break  # the final snapshot is close()'s call
+            ok = self.checkpoint_now(reason="auto") is not None
+            last_t = time.monotonic()
+            if ok:
+                backoff = self.config.worker_backoff_s
+            else:
+                # a failed snapshot leaves _merges_since_ckpt set, so
+                # the wait loop would re-trigger IMMEDIATELY: back off
+                # (capped exponential, stop-notify still wakes us)
+                # instead of re-serializing the version in a tight
+                # loop against a broken disk
+                with self._ckpt_cond:
+                    if not self._ckpt_stop:
+                        self._ckpt_cond.wait(backoff)
+                backoff = min(2 * backoff,
+                              self.config.worker_backoff_max_s)
+
+    def _stop_checkpointer(self, timeout: float) -> None:
+        if self._ckpt_thread is None:
+            return
+        with self._ckpt_cond:
+            self._ckpt_stop = True
+            self._ckpt_cond.notify_all()
+        self._ckpt_thread.join(timeout)
+        if self._ckpt_thread.is_alive():
+            raise TimeoutError(
+                f"serve checkpointer did not stop within {timeout}s"
+            )
+        self._ckpt_thread = None
+
+    @staticmethod
+    def from_recovery(grid, config: ServeConfig | None = None, *,
+                      kinds=None, tenant: str | None = None,
+                      combine: str | None = None) -> "Server":
+        """Boot a server from crash recovery: latest valid snapshot in
+        the durability dir + WAL-suffix replay
+        (``dynamic.wal.recover_version`` — bit-exact with the engine
+        that crashed, acknowledged writes included), with the WAL
+        re-attached at the seqno frontier so the write lane resumes
+        the same lineage.  Run ``warmup()`` before serving — with the
+        shared plan store populated it replays the fleet's remembered
+        lanes: zero retraces, zero re-measurement."""
+        from ..dynamic import wal as dyn_wal
+        from ..tuner import config as tuner_config
+        from .engine import GraphEngine
+
+        cfg = config or ServeConfig()
+        d = tuner_config.wal_dir(cfg.wal_dir)
+        if d is None:
+            raise ValueError(
+                "from_recovery needs a durability dir "
+                "(ServeConfig.wal_dir or COMBBLAS_WAL)"
+            )
+        # the Server attaches its own log handle afterwards
+        version = dyn_wal.recover(
+            d, grid, kinds=kinds, combine=combine, fsync=cfg.wal_fsync
+        )
+        engine = GraphEngine(grid, version=version, kinds=kinds)
+        return Server(engine, cfg, tenant=tenant)
 
     def submit_update(self, ops) -> Future:
         """Admit a batch of edge mutations — ``ops`` is a sequence of
@@ -299,6 +635,14 @@ class Server:
         self.faults.check("update.submit", nops=len(ops))
         fut: Future = Future()
         with self._upd_cond:
+            if self.scheduler.closed or self._upd_stop:
+                # RE-checked under the lock: a quarantine racing the
+                # unlocked check above has already failed/cleared
+                # _upd_futs — admitting here would append a future
+                # nothing will ever settle
+                raise RuntimeError(
+                    "serve.Server is closed; no further admissions"
+                )
             if self._upd_buffer is None:
                 self._upd_buffer = self._make_update_buffer()
             try:
@@ -314,6 +658,48 @@ class Server:
                 obs.count("serve.update.invalid")
                 fut.set_exception(e)
                 return fut
+            if self._wal is not None:
+                # durability: the record hits disk BEFORE the caller
+                # holds a future — "acknowledged" and "durable" are
+                # the same event.  A failed append REJECTS the write
+                # (tail rollback un-admits the ops; nothing else
+                # could touch the buffer: every mutator holds
+                # _upd_cond): the caller retries, and a write that
+                # was never acknowledged was never promised.
+                from ..dynamic.delta import _OP_CODE
+
+                first = last - len(ops) + 1
+                try:
+                    self.faults.check("wal.append", nops=len(ops))
+                    self._wal.append(
+                        first,
+                        [o[1] for o in ops],
+                        [o[2] for o in ops],
+                        [o[3] if len(o) > 3 else 1.0 for o in ops],
+                        [_OP_CODE[o[0]] for o in ops],
+                    )
+                    self._wal_frontier = last
+                except Exception as e:
+                    self._upd_buffer.rollback(first)
+                    obs.count("serve.wal.append_failed")
+                    try:
+                        # the line may have reached disk before the
+                        # failure (fsync raised): tombstone the range
+                        # so a crash cannot resurrect a write this
+                        # caller is being told FAILED.  Positional —
+                        # a later retry reusing the seqs is
+                        # untouched.  Best-effort: if even this write
+                        # fails, recovery may conservatively re-apply
+                        # the range.
+                        self._wal.append_drop(first, last)
+                    except Exception:
+                        pass
+                    self._flight_dump("wal_append_failed",
+                                      error=repr(e))
+                    raise RuntimeError(
+                        f"write NOT acknowledged: WAL append failed "
+                        f"({e!r}); retry"
+                    ) from e
             # write-lane trace (round 15): buffer wait -> merge ->
             # [fanout ->] swap -> settle; rid keyed by the batch's last
             # sequence number, so sampling is deterministic per op set
@@ -388,10 +774,14 @@ class Server:
             try:
                 self.faults.check("update.merge", nops=len(batch))
                 version = self.engine.apply_delta(batch)
+                # the version now contains every op through this seq:
+                # snapshot meta stamps it, recovery replays past it
+                version.wal_seq = batch.last_seq
                 t_merge = time.perf_counter()
                 for tr in traces:
                     tr.mark("merge", now=t_merge)
                 res = self.swap_graph(version)
+                self._wal_applied = batch.last_seq
                 t_swap = time.perf_counter()
                 st = version.dyn.last_stats
                 for tr in traces:
@@ -432,12 +822,27 @@ class Server:
                     batcher.settle(f, result=payload)
                 for tr in traces:
                     tr.finish(status="ok", stage="settle")
+                self._ckpt_note_merge()  # checkpoint trigger (rnd 16)
             except Exception as e:  # failure touches THIS batch only:
                 # the old version keeps serving, later merges proceed
                 self.update_failures += 1
                 obs.count(
                     "serve.update.failed", exc_type=type(e).__name__
                 )
+                if self._wal is not None:
+                    # the live lineage REJECTED these ops (their
+                    # futures fail below): tombstone the range so a
+                    # crash-recovery replay cannot resurrect writes
+                    # the callers were told failed.  Best-effort — if
+                    # even the tombstone cannot be written, recovery
+                    # may re-apply the range (the conservative side).
+                    try:
+                        self._wal.append_drop(
+                            batch.first_seq, batch.last_seq
+                        )
+                        self._wal_applied = batch.last_seq
+                    except Exception:
+                        obs.count("serve.wal.append_failed")
                 if rec is not None:
                     rec.record(
                         "serve.merge", ops=len(batch),
@@ -475,7 +880,8 @@ class Server:
             # merge(s) run before the thread exits (close() drains)
             self._merge_once()
 
-    def _stop_mutator(self, drain: bool, timeout: float) -> None:
+    def _stop_mutator(self, drain: bool, timeout: float,
+                      abort_exc: Exception | None = None) -> None:
         futs: list = []
         with self._upd_cond:
             self._upd_stop = True
@@ -493,7 +899,9 @@ class Server:
                 self._upd_futs.clear()
             self._upd_cond.notify_all()
         if not drain:
-            exc = RuntimeError("serve.Server closed without drain")
+            exc = abort_exc if abort_exc is not None else RuntimeError(
+                "serve.Server closed without drain"
+            )
             for f, tr in futs:
                 batcher.settle(f, exc=exc)
                 if tr is not None:  # abandoned writes still close
@@ -744,6 +1152,17 @@ class Server:
             with self._wake:
                 if self._stop:
                     break
+            # replica.death (round 16): OUTSIDE the recovery ladder by
+            # design — when this fires the worker thread DIES, exactly
+            # the failure mode the fleet supervisor exists to detect
+            # (health() flips "down"; chaos tests and the recovery
+            # bench kill replicas through this point).  The thread
+            # exits without settling anything — a crash settles
+            # nothing either.
+            try:
+                self.faults.check("replica.death")
+            except InjectedFault:
+                return
             # pump BEFORE sleeping: requests that arrived while the
             # previous batch executed (their notify found no waiter)
             # may already fill a lane bucket — flush-on-full must not
@@ -825,6 +1244,11 @@ class Server:
             version = self.engine.build_version(
                 rows, cols, weights=weights, **build_kw
             )
+        if self._wal is not None and version.wal_seq < 0:
+            # an externally built version (hot-swap) carries no merge
+            # lineage stamp: it supersedes everything MERGED so far,
+            # while appended-but-unmerged ops still apply on top later
+            version.wal_seq = self._wal_applied
         self.faults.check("engine.swap", version=version)
         swap_s = self.engine.swap(version)
         return {
@@ -885,6 +1309,7 @@ class Server:
             lane_widths=list(self.config.lane_widths),
             max_queue=self.config.max_queue,
             updates=self._update_stats(),
+            durability=self._durability_stats(),
             slo=self.slo.describe() if self.slo is not None else None,
             flightrec=(
                 self._recorder.describe()
@@ -917,6 +1342,59 @@ class Server:
                 k: round(v, 6) for k, v in self._merge_s.items()
             },
             "buffer": buf,
+        }
+
+    def is_serving(self) -> bool:
+        """Cheap routing-time liveness (round 16): an open front door
+        whose worker (if ever started) is alive.  A never-started
+        server counts as serving — the worker-less pump()-driven
+        embedding.  The fleet's ``_route_order`` calls this per
+        submit, so it must stay two attribute reads, not a full
+        ``health()`` dict build."""
+        if self.scheduler.closed:
+            return False
+        w = self._worker
+        return w is None or w.is_alive()
+
+    def quarantine(self, exc: Exception, timeout: float = 10.0) -> int:
+        """Take a DEAD replica out of service (round 16, the fleet
+        supervisor's cleanup): refuse new admissions, fail every
+        pending read and buffered write future with ``exc`` — honest
+        failure, never a silent drop; with a WAL attached the
+        acknowledged writes themselves are NOT lost (they are on disk,
+        and recovery/promotion replays them) — and stop the mutation
+        and checkpointer threads.  Unlike ``close(drain=True)`` this
+        never executes anything: the worker is presumed dead and the
+        engine's state untrustworthy to drive.  Returns futures
+        failed."""
+        self.scheduler.close()
+        with self._wake:
+            self._stop = True
+            self._wake.notify_all()
+        n = self.scheduler.fail_pending(exc)
+        with self._upd_cond:
+            pending = len(self._upd_futs)
+        self._stop_mutator(drain=False, timeout=timeout, abort_exc=exc)
+        self._stop_checkpointer(timeout)
+        if self._wal is not None:
+            self._wal.close()
+        obs.count("serve.fleet.quarantined")
+        return n + pending
+
+    def _durability_stats(self) -> dict | None:
+        """WAL + checkpointer disposition (None when durability is
+        off — the common case pays one attribute read)."""
+        if self._wal is None:
+            return None
+        with self._ckpt_cond:
+            since = self._merges_since_ckpt
+        return {
+            "dir": self._ckpt_dir,
+            "wal": self._wal.stats(),
+            "checkpoints": self.checkpoints,
+            "checkpoint_failures": self.checkpoint_failures,
+            "merges_since_checkpoint": since,
+            "wal_frontier": self._wal_frontier,
         }
 
     def health(self) -> dict:
@@ -975,4 +1453,9 @@ class Server:
             "mutator_alive": (
                 self._mutator is not None and self._mutator.is_alive()
             ),
+            "durable": self._wal is not None,
+            "wal_frontier": (
+                self._wal_frontier if self._wal is not None else None
+            ),
+            "checkpoints": self.checkpoints,
         }

@@ -107,6 +107,11 @@ class GraphVersion:
     delta_from: tuple | None = None  # (parent vid, inserted keys,
     #                                removed keys) — refresh lineage
     vid: int = 0                   # assigned when installed/swapped in
+    wal_seq: int = -1              # highest WAL sequence number folded
+    #                                into this version (-1 = none) —
+    #                                stamped into snapshot meta so
+    #                                recovery replays exactly the
+    #                                unapplied log suffix (round 16)
 
     def device_bytes(self) -> int:
         """Resident DEVICE bytes of this version: every uploaded array

@@ -21,7 +21,10 @@ Failure points (``FAULT_POINTS``):
   (the write lane's front door);
 * ``update.merge``     — in the mutation thread, before
   ``engine.apply_delta`` runs (a failed merge must fail exactly the
-  updates it carried and leave the current version serving).
+  updates it carried and leave the current version serving);
+* ``wal.append`` / ``checkpoint.save`` / ``replica.death`` /
+  ``fleet.fanout`` — the round-16 durability & self-healing points
+  (see the ``FAULT_POINTS`` comment below for each one's contract).
 
 Rules, all deterministic:
 
@@ -54,6 +57,16 @@ import threading
 from .. import obs
 
 #: Named failure points the serve stack threads through the injector.
+#: Round 16 adds the durability / self-healing points:
+#: ``wal.append`` (inside ``submit_update``, before the write is
+#: acknowledged — a failed append must reject the write, never
+#: acknowledge an undurable one), ``checkpoint.save`` (the background
+#: checkpointer — a failed snapshot must leave the previous one and
+#: the un-truncated WAL intact), ``replica.death`` (checked at the top
+#: of the api worker loop OUTSIDE its recovery ladder, so firing it
+#: kills the worker thread — the fleet supervisor's detection target),
+#: and ``fleet.fanout`` (per-replica inside ``FleetRouter.fan_out`` —
+#: a failed replica rebuild must lag visibly, not abort the fleet).
 FAULT_POINTS = (
     "scheduler.admit",
     "batch.assemble",
@@ -62,6 +75,10 @@ FAULT_POINTS = (
     "engine.swap",
     "update.submit",
     "update.merge",
+    "wal.append",
+    "checkpoint.save",
+    "replica.death",
+    "fleet.fanout",
 )
 
 

@@ -1,9 +1,9 @@
-"""FleetRouter — N replica servers behind one front door (round 14).
+"""FleetRouter — N replica servers behind one front door (rounds 14/16).
 
 The horizontal half of the serving story: the pool multiplexes many
 GRAPHS behind one device; the fleet multiplexes many REPLICAS of one
 graph behind one router, the shape a real service scales reads with.
-Three properties make it more than a load balancer:
+Properties that make it more than a load balancer:
 
 * **One warm plan store.** Every replica resolves routing and records
   serve warmup lanes through the SAME ``tuner.store`` JSONL (already
@@ -26,19 +26,39 @@ Three properties make it more than a load balancer:
   atomic ``swap_graph`` — readers on every replica keep serving the old
   version mid-build and flip in one pointer swap (incremental merges
   preserve operand shapes, so the warm plans survive fleet-wide).
+* **Durability + self-healing (round 16, docs/serving.md "Durability
+  & self-healing").** With a durability dir configured (``wal_dir`` /
+  ``COMBBLAS_WAL``), the HOME replica owns the write-ahead log and the
+  background checkpointer — acknowledged writes survive any process
+  crash.  A ``start_supervisor()`` thread (or deterministic
+  ``supervise_once()`` calls) detects replicas whose worker thread
+  died, QUARANTINES them (pending futures failed honestly — never
+  silently dropped), rebuilds replacements OFF-lock from
+  checkpoint+WAL (or the home's retained COO when not durable) and
+  re-admits them warm; a dead HOME is first replaced by PROMOTING a
+  surviving replica to the WAL's seqno frontier — the single merge
+  lineage is preserved because the frontier is exactly "every
+  acknowledged write".  ``drain()``/``restore()``/``rolling_restart()``
+  make upgrades a first-class operation, and reads that fail
+  execution-side are retried (bounded, reads only) on the next-best
+  replica.
 
-Reads route to the least-loaded replica (queue depth, round-robin tie
-break) and SPILL OVER on backpressure: only when every replica rejects
-does the caller see the last ``BackpressureError``.
+Reads route to the least-loaded SERVING replica (queue depth,
+round-robin tie break; dead/closed/draining replicas attract no
+traffic) and SPILL OVER on backpressure: only when every replica
+rejects does the caller see the last ``BackpressureError``.
 
 Thread-hosted replicas: each ``Server`` owns its own engine, queue,
 breakers and worker thread inside this process — the honest analog of
 a replica fleet on the tier-1 virtual mesh, and exactly what one host
-of a multi-host fleet runs per chip.
+of a multi-host fleet runs per chip.  "Replica death" is worker-thread
+death (the ``replica.death`` fault point); a real multi-process fleet
+swaps thread liveness for process liveness and keeps everything else.
 """
 
 from __future__ import annotations
 
+import dataclasses
 import itertools
 import threading
 import time
@@ -46,13 +66,32 @@ from concurrent.futures import Future
 
 from .. import obs
 from .batcher import settle
+from .faults import FaultInjector
 from .scheduler import BackpressureError, ServeConfig
+
+
+class ReplicaDeadError(RuntimeError):
+    """A replica's worker thread died and the supervisor took it out
+    of service: its pending futures fail with this.  With a WAL
+    attached the ACKNOWLEDGED writes themselves are durable (recovery
+    / promotion replays them) — only the futures fail, honestly."""
+
+
+def _strip_wal(cfg: ServeConfig, keep: str | None) -> ServeConfig:
+    """Per-replica durability config: the home replica gets the
+    resolved dir, every other replica gets an EXPLICIT "off" — an
+    ambient ``COMBBLAS_WAL`` must not make N replicas fight over one
+    log file with N bootstrap snapshots."""
+    return dataclasses.replace(
+        cfg, wal_dir=(keep if keep is not None else "off")
+    )
 
 
 class FleetRouter:
     """Front door over N replica ``Server``s sharing one plan store."""
 
-    def __init__(self, servers, home: int = 0, build_kw: dict | None = None):
+    def __init__(self, servers, home: int = 0,
+                 build_kw: dict | None = None):
         if not servers:
             raise ValueError("FleetRouter needs at least one replica")
         self.replicas = list(servers)
@@ -82,6 +121,30 @@ class FleetRouter:
         self.spillovers = 0
         self.fanouts = 0
         self._scrape = None  # obs.export.ScrapeServer (serve_metrics)
+        # -- self-healing state (round 16) -----------------------------
+        #: Fleet-level fault injection (the ``fleet.fanout`` point).
+        self.faults = FaultInjector()
+        #: Durability dir (the home's) — promotion / replacement source.
+        self.wal_dir = self.replicas[self.home]._ckpt_dir
+        # fan-out generation accounting: versions_behind[i] =
+        # _fan_gen - _replica_gen[i] (0 = replica serves the home's
+        # latest fanned-out version)
+        self._fan_gen = 0
+        self._replica_gen = [0] * len(self.replicas)
+        self._draining: set[int] = set()
+        self._drain_gen: dict[int, int] = {}  # fan gen at drain time
+        # slots whose quarantined server still awaits a replacement:
+        # STICKY until _spawn_replica heals them — _dead() goes False
+        # the moment quarantine closes the scheduler, so without this
+        # a transient rebuild failure would be forgotten forever
+        self._needs_rebuild: set[int] = set()
+        self._sup_lock = threading.RLock()  # serializes heal actions
+        self._sup_thread: threading.Thread | None = None
+        self._sup_stop = threading.Event()
+        self._sup_interval = 0.05
+        self.promotions = 0
+        self.replacements = 0
+        self.read_retries = 0
         obs.gauge("serve.fleet.replicas", len(self.replicas))
 
     def serve_metrics(self, port: int = 0, host: str = "127.0.0.1"
@@ -95,20 +158,33 @@ class FleetRouter:
     # -- construction ------------------------------------------------------
 
     @staticmethod
+    def _resolved_wal(wal_dir, config) -> str | None:
+        from ..tuner import config as tuner_config
+
+        return tuner_config.wal_dir(
+            wal_dir if wal_dir is not None
+            else (config.wal_dir if config is not None else None)
+        )
+
+    @staticmethod
     def build(grid, rows, cols, nrows: int, *,
               replicas: int | None = None,
               config: ServeConfig | None = None,
               home: int = 0, start: bool = True,
+              wal_dir: str | None = None,
               **from_coo_kw) -> "FleetRouter":
         """Build N replicas from one COO (``COMBBLAS_FLEET_REPLICAS``
         defaults the count). The home replica keeps the host edge list
         (``keep_coo=True`` forced) — it feeds both the write lane and
-        the fan-out rebuilds."""
+        the fan-out rebuilds.  ``wal_dir`` (argument > config >
+        ``COMBBLAS_WAL``) attaches the durability layer to the HOME
+        replica: write-ahead log + background checkpointer."""
         from .api import Server
         from .engine import GraphEngine
         from ..tuner import config as tuner_config
 
         n = tuner_config.fleet_replicas(replicas)
+        resolved = FleetRouter._resolved_wal(wal_dir, config)
         servers = []
         for i in range(n):
             kw = dict(from_coo_kw)
@@ -116,8 +192,14 @@ class FleetRouter:
                 kw["keep_coo"] = True
             eng = GraphEngine.from_coo(grid, rows, cols, nrows, **kw)
             servers.append(
-                Server(eng, config or ServeConfig(),
-                       tenant=f"replica{i}")
+                Server(
+                    eng,
+                    _strip_wal(
+                        config or ServeConfig(),
+                        resolved if i == home else None,
+                    ),
+                    tenant=f"replica{i}",
+                )
             )
         build_kw = {
             k: from_coo_kw[k] for k in ("symmetric",)
@@ -134,6 +216,7 @@ class FleetRouter:
                         replicas: int | None = None,
                         config: ServeConfig | None = None,
                         kinds=None, home: int = 0, start: bool = True,
+                        wal_dir: str | None = None,
                         symmetric: bool = True) -> "FleetRouter":
         """Boot N replicas from one ``save_version`` snapshot — the
         cold-replica warm start: every replica's version re-uploads the
@@ -147,16 +230,75 @@ class FleetRouter:
         from ..utils import checkpoint
 
         n = tuner_config.fleet_replicas(replicas)
+        resolved = FleetRouter._resolved_wal(wal_dir, config)
         servers = []
         for i in range(n):
             # one independent version per replica: engines swap and
             # version-stamp independently, so sharing one GraphVersion
-            # object would cross-wire their lineages
-            v = checkpoint.load_version(path, grid)
+            # object would cross-wire their lineages.  Only the HOME
+            # loads writable — read replicas must not each pin an
+            # O(nnz) host copy of the merge-state source
+            v = checkpoint.load_version(
+                path, grid, writable=(i == home)
+            )
             eng = GraphEngine(grid, version=v, kinds=kinds)
             servers.append(
-                Server(eng, config or ServeConfig(),
-                       tenant=f"replica{i}")
+                Server(
+                    eng,
+                    _strip_wal(
+                        config or ServeConfig(),
+                        resolved if i == home else None,
+                    ),
+                    tenant=f"replica{i}",
+                )
+            )
+        router = FleetRouter(
+            servers, home=home, build_kw={"symmetric": symmetric}
+        )
+        if start:
+            for s in servers:
+                s.start()
+        return router
+
+    @staticmethod
+    def from_recovery(grid, *, replicas: int | None = None,
+                      config: ServeConfig | None = None,
+                      kinds=None, home: int = 0, start: bool = True,
+                      wal_dir: str | None = None,
+                      symmetric: bool = True) -> "FleetRouter":
+        """Boot a whole fleet from crash recovery (round 16): every
+        replica's version = latest valid snapshot + WAL-suffix replay
+        (``dynamic.wal.recover_version`` — bit-exact with the fleet
+        that crashed, every acknowledged write included), the home
+        re-attached to the WAL at the seqno frontier.  With the shared
+        plan store populated, ``warmup()`` replays the remembered
+        lanes — warm plans, zero retraces, zero re-measurement."""
+        from .api import Server
+        from .engine import GraphEngine
+        from ..dynamic import wal as dyn_wal
+        from ..tuner import config as tuner_config
+
+        resolved = FleetRouter._resolved_wal(wal_dir, config)
+        if resolved is None:
+            raise ValueError(
+                "FleetRouter.from_recovery needs a durability dir "
+                "(wal_dir=, ServeConfig.wal_dir or COMBBLAS_WAL)"
+            )
+        n = tuner_config.fleet_replicas(replicas)
+        servers = []
+        for i in range(n):
+            cfg_i = _strip_wal(
+                config or ServeConfig(), resolved if i == home else None
+            )
+            if i == home:
+                servers.append(Server.from_recovery(
+                    grid, cfg_i, kinds=kinds, tenant=f"replica{i}"
+                ))
+                continue
+            v = dyn_wal.recover(resolved, grid, kinds=kinds)
+            eng = GraphEngine(grid, version=v, kinds=kinds)
+            servers.append(
+                Server(eng, cfg_i, tenant=f"replica{i}")
             )
         router = FleetRouter(
             servers, home=home, build_kw={"symmetric": symmetric}
@@ -169,34 +311,115 @@ class FleetRouter:
     # -- read path ---------------------------------------------------------
 
     def _route_order(self) -> list[int]:
-        """Replica indices, least queue depth first; ties broken by a
-        rotating offset so equal-depth replicas share evenly."""
-        depths = [s.scheduler.depth() for s in self.replicas]
+        """SERVING replica indices, least queue depth first; ties
+        broken by a rotating offset so equal-depth replicas share
+        evenly.  Dead (worker died), closed, and draining replicas are
+        SKIPPED — before round 16 a dead replica still attracted
+        traffic purely by its empty queue depth."""
+        alive = [
+            i for i, s in enumerate(self.replicas)
+            if i not in self._draining and s.is_serving()
+        ]
+        if not alive:
+            # nothing serves: route everywhere so the caller sees the
+            # real rejection instead of an empty-fleet IndexError
+            alive = list(range(len(self.replicas)))
+        depths = {i: self.replicas[i].scheduler.depth() for i in alive}
         off = next(self._rr) % len(self.replicas)
         return sorted(
-            range(len(self.replicas)),
+            alive,
             key=lambda i: (depths[i], (i - off) % len(self.replicas)),
         )
 
-    def submit(self, kind: str, root, timeout_s: float | None = None):
-        """Route one query to the least-loaded replica, spilling to
-        the next on backpressure/breaker rejection; raises the LAST
-        rejection only when every replica refused."""
+    def submit(self, kind: str, root, timeout_s: float | None = None,
+               read_retry: int = 1):
+        """Route one query to the least-loaded serving replica,
+        spilling to the next on backpressure/breaker rejection; raises
+        the LAST rejection only when every replica refused.
+
+        ``read_retry`` (round 16) bounds execution-side retries: a
+        future that fails with a replica-level error (worker death,
+        injected fault, poison-exhausted batch — NOT backpressure,
+        malformed-root, or deadline errors) is re-submitted once per
+        budget unit to the next-best OTHER replica before the caller
+        sees the failure.  Reads only — writes have exactly one home
+        lineage and never retry implicitly."""
         last_exc: Exception | None = None
         for i in self._route_order():
             try:
                 fut = self.replicas[i].submit(
                     kind, root, timeout_s=timeout_s
                 )
-            except BackpressureError as e:
+            except (BackpressureError, RuntimeError) as e:
+                # backpressure/breaker — or a replica quarantined/
+                # closed between _route_order's liveness check and
+                # this submit (its scheduler raises RuntimeError):
+                # spill to the next replica either way, matching the
+                # retry path's exception taxonomy
                 self.spillovers += 1
                 obs.count("serve.fleet.spillover", replica=i)
                 last_exc = e
                 continue
             self.submitted[i] += 1
             obs.count("serve.fleet.submitted", replica=i)
+            if read_retry > 0:
+                return self._with_read_retry(
+                    fut, kind, root, timeout_s, i, read_retry
+                )
             return fut
         raise last_exc  # every replica rejected
+
+    def _with_read_retry(self, fut, kind, root, timeout_s,
+                         replica: int, budget: int) -> Future:
+        """Wrap a submitted read's future: on an execution-side
+        failure, re-submit to the next-best OTHER serving replica
+        (bounded by ``budget``); the outer future sees the retried
+        outcome.  Admission-level rejections (backpressure/breaker),
+        malformed roots (ValueError) and expired deadlines
+        (TimeoutError) are NOT retried — they would fail identically
+        or lie about the deadline."""
+        outer: Future = Future()
+
+        def _done(f):
+            exc = f.exception()
+            if exc is None:
+                settle(outer, result=f.result())
+                return
+            if budget <= 0 or isinstance(
+                exc, (BackpressureError, ValueError, TimeoutError)
+            ):
+                settle(outer, exc=exc)
+                return
+            for j in self._route_order():
+                if j == replica:
+                    continue
+                try:
+                    f2 = self.replicas[j].submit(
+                        kind, root, timeout_s=timeout_s
+                    )
+                except (BackpressureError, RuntimeError):
+                    continue
+                self.read_retries += 1
+                self.submitted[j] += 1
+                obs.count("serve.fleet.read_retry", replica=j)
+                inner = self._with_read_retry(
+                    f2, kind, root, timeout_s, j, budget - 1
+                )
+                inner.add_done_callback(
+                    lambda g: settle(
+                        outer,
+                        result=(
+                            g.result() if g.exception() is None
+                            else None
+                        ),
+                        exc=g.exception(),
+                    )
+                )
+                return
+            settle(outer, exc=exc)  # nowhere to retry
+
+        fut.add_done_callback(_done)
+        return outer
 
     def submit_many(self, kind: str, roots,
                     timeout_s: float | None = None) -> list:
@@ -220,8 +443,10 @@ class FleetRouter:
         """Route a mutation batch to the HOME replica; once its merge
         lands, fan the new version out to every other replica through
         the atomic swap. The returned future resolves (with the home
-        merge payload plus ``fanned_out``) after the whole fleet
-        serves the new version."""
+        merge payload plus ``fanned_out``) after the serving fleet
+        runs the new version — a replica whose rebuild failed mid-fan
+        LAGS visibly (``versions_behind``, degraded health, retried on
+        the next fan-out) instead of failing the write."""
         home = self.replicas[self.home]
         inner = home.submit_update(ops)
         if not fan_out:
@@ -241,10 +466,13 @@ class FleetRouter:
             tr = getattr(f, "_combblas_trace", None)
             try:
                 payload["fanned_out"] = self.fan_out()
+                payload["lagging"] = self.lagging()
                 if tr is not None:
                     tr.mark("fanout")
-            except Exception as e:  # the home merge LANDED; a failed
-                # fan-out is a divergence the caller must see
+            except Exception as e:  # fan_out itself tolerates
+                # per-replica failures; reaching here means the fan
+                # could not run at all (e.g. the home lost its COO) —
+                # a divergence the caller must see
                 settle(outer, exc=e)
                 return
             settle(outer, result=payload)
@@ -254,10 +482,17 @@ class FleetRouter:
 
     def fan_out(self) -> int:
         """Propagate the home replica's CURRENT version to every other
-        replica: rebuild each replica's own version from the home
-        version's retained host COO (off that replica's execution
-        lock — its readers keep serving) and swap atomically. Returns
-        replicas updated."""
+        serving replica: rebuild each replica's own version from the
+        home version's retained host COO (off that replica's execution
+        lock — its readers keep serving) and swap atomically.
+
+        Round 16: a replica whose rebuild/swap FAILS (or that is
+        dead/draining) no longer aborts the fleet — it stays on its
+        old version, counted and gauged per replica
+        (``serve.fleet.versions_behind``), degrades fleet ``health()``
+        and is RETRIED on the next fan-out (every fan-out rebuilds all
+        lagging replicas from the current home version).  Returns
+        replicas updated this call."""
         with self._fan_lock:
             v = self.replicas[self.home].engine.version
             if v.host_coo is None:
@@ -268,23 +503,383 @@ class FleetRouter:
                 )
             rows, cols, _nc = v.host_coo
             weights = v.host_weights
+            self._fan_gen += 1
+            gen = self._fan_gen
             t0 = time.perf_counter()
             n = 0
             for i, srv in enumerate(self.replicas):
                 if i == self.home:
+                    self._replica_gen[i] = gen
                     continue
-                nv = srv.engine.build_version(
-                    rows, cols, weights=weights, keep_coo=False,
-                    **self.build_kw,
-                )
-                srv.swap_graph(nv)
-                n += 1
+                if i in self._draining or not srv.is_serving():
+                    # dead/draining replicas lag on purpose — the
+                    # supervisor (or restore()) rebuilds them at the
+                    # frontier, where they catch up in one step
+                    continue
+                try:
+                    self.faults.check("fleet.fanout", replica=i)
+                    nv = srv.engine.build_version(
+                        rows, cols, weights=weights, keep_coo=False,
+                        **self.build_kw,
+                    )
+                    srv.swap_graph(nv)
+                    self._replica_gen[i] = gen
+                    n += 1
+                except Exception:
+                    obs.count("serve.fleet.fanout_failed", replica=i)
             self.fanouts += 1
             obs.count("serve.fleet.fanout")
             obs.observe(
                 "serve.fleet.fanout_s", time.perf_counter() - t0
             )
+            for i in range(len(self.replicas)):
+                obs.gauge(
+                    "serve.fleet.versions_behind",
+                    gen - self._replica_gen[i], replica=i,
+                )
             return n
+
+    def lagging(self) -> list[int]:
+        """Replica indices serving an older version than the home's
+        latest fan-out (failed/skipped rebuilds — retried next
+        fan-out; degraded ``health()`` while non-empty)."""
+        return [
+            i for i in range(len(self.replicas))
+            if i != self.home
+            and self._replica_gen[i] < self._fan_gen
+        ]
+
+    # -- self-healing: supervision, promotion, rolling restart -------------
+
+    def start_supervisor(self, interval_s: float = 0.05
+                         ) -> "FleetRouter":
+        """Start the liveness supervisor thread: every ``interval_s``
+        it runs ``supervise_once()`` — dead-replica detection,
+        replacement rebuilds, home promotion.  Idempotent; stopped by
+        ``close()`` / ``stop_supervisor()``."""
+        with self._sup_lock:
+            if self._sup_thread is None or not self._sup_thread.is_alive():
+                self._sup_stop.clear()
+                self._sup_interval = float(interval_s)
+                self._sup_thread = threading.Thread(
+                    target=self._sup_loop, name="combblas-fleet-sup",
+                    daemon=True,
+                )
+                self._sup_thread.start()
+        return self
+
+    def stop_supervisor(self, timeout: float = 10.0) -> None:
+        t = self._sup_thread
+        if t is None:
+            return
+        self._sup_stop.set()
+        t.join(timeout)
+        if t.is_alive():
+            raise TimeoutError(
+                f"fleet supervisor did not stop within {timeout}s"
+            )
+        self._sup_thread = None
+
+    def _sup_loop(self) -> None:
+        while not self._sup_stop.is_set():
+            try:
+                self.supervise_once()
+            except Exception as e:  # the supervisor must outlive any
+                # one heal attempt: a failed rebuild is retried on the
+                # next tick, visible in the counter — a dead
+                # supervisor would silently stop all self-healing
+                obs.count(
+                    "serve.fleet.supervisor",
+                    action="error", exc_type=type(e).__name__,
+                )
+            self._sup_stop.wait(self._sup_interval)
+
+    def _dead(self, i: int) -> bool:
+        """Worker-thread death: started once, no longer running, and
+        not closed by us (closed = deliberate)."""
+        s = self.replicas[i]
+        w = s._worker
+        return (
+            w is not None and not w.is_alive()
+            and not s._stop and not s.scheduler.closed
+        )
+
+    def supervise_once(self) -> dict:
+        """One supervision pass (the supervisor thread's body, callable
+        directly for deterministic tests): detect replicas whose
+        worker died, promote a new home first if the HOME died, then
+        rebuild every dead replica off-lock and re-admit it.  Returns
+        ``{"detected": [...], "promoted": new_home | None,
+        "replaced": [...]}``."""
+        with self._sup_lock:
+            dead = [
+                i for i in range(len(self.replicas))
+                if i not in self._draining
+                and (self._dead(i) or i in self._needs_rebuild)
+            ]
+            out = {"detected": dead, "promoted": None, "replaced": []}
+            if not dead:
+                return out
+            for i in dead:
+                if i not in self._needs_rebuild:
+                    obs.count(
+                        "serve.fleet.supervisor", action="detected"
+                    )
+                # sticky until _spawn_replica heals the slot: a
+                # transient rebuild failure below must be RETRIED on
+                # the next tick, not forgotten (quarantine flips
+                # _dead() false)
+                self._needs_rebuild.add(i)
+            if self.home in dead:
+                try:
+                    out["promoted"] = self.promote()
+                except RuntimeError:
+                    # no WAL to promote from (or no surviving
+                    # replica, or a transient recovery failure):
+                    # promote() already quarantined the home — its
+                    # buffered futures failed honestly — and the
+                    # replace loop below still rebuilds the slot
+                    # (from checkpoint+WAL when durable, else from
+                    # the dead engine's retained COO: the engine
+                    # object outlives its worker thread), so the
+                    # write lane comes back instead of staying down
+                    obs.count(
+                        "serve.fleet.supervisor",
+                        action="promotion_failed",
+                    )
+            for i in dead:
+                try:
+                    self._replace_replica(i)
+                except Exception:
+                    # stays in _needs_rebuild: retried next tick
+                    obs.count(
+                        "serve.fleet.supervisor",
+                        action="replace_error",
+                    )
+                    continue
+                out["replaced"].append(i)
+                obs.count("serve.fleet.supervisor", action="replaced")
+            return out
+
+    def promote(self, new_home: int | None = None) -> int:
+        """Promote a surviving replica to HOME (round 16) — the
+        dead-home failover.  The single merge lineage is preserved by
+        promoting AT THE WAL'S SEQNO FRONTIER: the new home's version
+        is ``recover_version`` (latest snapshot + full WAL-suffix
+        replay), which contains exactly every ACKNOWLEDGED write —
+        including writes the dead home had buffered but not merged.
+        Those buffered writes' futures are failed honestly
+        (``ReplicaDeadError``; the data itself is durable and present
+        at the frontier — the futures' callers just never got their
+        merge confirmation).  The WAL and checkpointer re-attach to
+        the new home; the dead ex-home becomes a regular replica slot
+        for ``_replace_replica``.  Returns the new home index."""
+        with self._sup_lock:
+            old = self.home
+            old_srv = self.replicas[old]
+            if self.wal_dir is None:
+                # no WAL: the un-merged buffered writes died with the
+                # home (there is no durable record to promote from) —
+                # fail them honestly and surface the degraded fleet;
+                # reads keep serving on the other replicas
+                old_srv.quarantine(ReplicaDeadError(
+                    f"home replica {old} died without a WAL; buffered "
+                    "writes are lost (configure wal_dir for durable "
+                    "failover)"
+                ))
+                raise RuntimeError(
+                    "home promotion needs fleet durability (wal_dir / "
+                    "COMBBLAS_WAL): without a write-ahead log the "
+                    "write lineage died with the home replica"
+                )
+            if new_home is None:
+                cands = [
+                    i for i in self._route_order()
+                    if i != old and self.replicas[i].is_serving()
+                ]
+                if not cands:
+                    raise RuntimeError(
+                        "no serving replica available to promote"
+                    )
+                new_home = cands[0]
+            # 1. fail the dead home's pending futures honestly (reads
+            #    AND buffered writes; acknowledged writes are in the
+            #    WAL and reappear at the recovered frontier below)
+            old_srv.quarantine(ReplicaDeadError(
+                f"home replica {old} died; promoting replica "
+                f"{new_home} at the WAL frontier (acknowledged "
+                "writes are durable and replayed there)"
+            ))
+            # 2. bring the new home to the frontier: snapshot + full
+            #    WAL-suffix replay = every acknowledged write
+            from ..dynamic import wal as dyn_wal
+
+            ns = self.replicas[new_home]
+            v = dyn_wal.recover(
+                self.wal_dir, ns.engine.grid, kinds=ns.engine.kinds()
+            )
+            ns.swap_graph(v)
+            # 3. the write lane follows the lineage: WAL + background
+            #    checkpointer re-attach to the new home
+            ns.attach_durability(self.wal_dir)
+            # the recovered version's bucket shapes (the donor's
+            # sticky layout) may differ from the fan-out-rebuilt ones
+            # this replica served: re-warm so steady state stays
+            # zero-retrace after the promotion
+            try:
+                ns.warmup()
+            except Exception:
+                obs.count(
+                    "serve.fleet.supervisor", action="warmup_error"
+                )
+            self.home = new_home
+            self._replica_gen[new_home] = self._fan_gen
+            self.promotions += 1
+            obs.count("serve.fleet.promotions")
+            # propagate the recovered frontier to the SURVIVING
+            # replicas NOW: the recovery may contain acknowledged
+            # writes the dead home never fanned out, and waiting for
+            # the next write (possibly never, on a read-heavy
+            # service) would serve split-brain reads while health()
+            # reports ok.  Best-effort: a failed rebuild lags visibly
+            # (versions_behind / degraded health) as usual.
+            try:
+                self.fan_out()
+            except Exception:
+                obs.count(
+                    "serve.fleet.supervisor", action="fanout_error"
+                )
+            return new_home
+
+    def _spawn_replica(self, i: int, engine, started: bool) -> None:
+        """Install a fresh ``Server`` shell around ``engine`` at slot
+        ``i`` (shared exec lock, same tenant label), warmed from the
+        shared plan store before it takes traffic."""
+        from .api import Server
+
+        cfg = _strip_wal(
+            self.replicas[i].config,
+            self.wal_dir if i == self.home else None,
+        )
+        engine._exec_lock = self._device_lock
+        new = Server(engine, cfg, tenant=f"replica{i}")
+        if started:
+            new.start()
+        # warm BEFORE admitting traffic: the shared store replays the
+        # fleet's remembered lanes, so the replacement reaches
+        # zero-retrace steady state off the routing path
+        try:
+            new.warmup()
+        except Exception:
+            obs.count("serve.fleet.supervisor", action="warmup_error")
+        self.replicas[i] = new
+        self._replica_gen[i] = self._fan_gen
+        self._needs_rebuild.discard(i)  # the slot is healed
+
+    def _replace_replica(self, i: int) -> None:
+        """Rebuild a DEAD replica off-lock and re-admit it: from
+        checkpoint+WAL when durable (the crash-consistent source),
+        else from the home version's retained host COO (the fan-out
+        recipe).  The dead server's pending futures were already
+        failed by ``promote``/``quarantine`` — or are failed here."""
+        from .engine import GraphEngine
+
+        old = self.replicas[i]
+        if not old.scheduler.closed:  # promote() may have quarantined
+            old.quarantine(ReplicaDeadError(
+                f"replica {i} worker died; the fleet supervisor is "
+                "rebuilding a replacement"
+            ))
+        grid = old.engine.grid
+        kinds = old.engine.kinds()
+        if self.wal_dir is not None:
+            from ..dynamic import wal as dyn_wal
+
+            v = dyn_wal.recover(self.wal_dir, grid, kinds=kinds)
+            engine = GraphEngine(grid, version=v, kinds=kinds)
+        else:
+            hv = self.replicas[self.home].engine.version
+            if hv.host_coo is None:
+                raise RuntimeError(
+                    "cannot rebuild a dead replica: no durability dir "
+                    "and the home retained no host COO"
+                )
+            rows, cols, _nc = hv.host_coo
+            engine = GraphEngine.from_coo(
+                grid, rows, cols, int(hv.nrows),
+                weights=hv.host_weights, kinds=kinds,
+                # a rebuilt HOME must keep feeding the write lane and
+                # the fan-out rebuilds (the non-durable fresh lineage)
+                keep_coo=(i == self.home),
+                **self.build_kw,
+            )
+        self._spawn_replica(i, engine, started=True)
+        self.replacements += 1
+        obs.count("serve.fleet.replaced", replica=i)
+
+    def drain(self, i: int, timeout: float = 30.0) -> None:
+        """Take replica ``i`` out of rotation and close it CLEANLY —
+        queued reads execute, buffered writes merge (and, on a durable
+        home, checkpoint), then the worker stops.  The first half of a
+        rolling restart; ``restore()`` re-admits the slot.  Draining
+        the HOME makes writes reject until it is restored (one write
+        lineage — by design)."""
+        with self._sup_lock:
+            if not (0 <= i < len(self.replicas)):
+                raise ValueError(f"no replica {i}")
+            self._draining.add(i)
+            self._drain_gen[i] = self._fan_gen
+        obs.count("serve.fleet.drained", replica=i)
+        self.replicas[i].close(drain=True, timeout=timeout)
+
+    def restore(self, i: int) -> None:
+        """Re-admit a drained replica: a fresh ``Server`` shell around
+        the SAME (healthy, warm) engine — plan cache intact, zero
+        rebuild, zero retraces.  A durable home re-attaches the WAL at
+        the frontier it drained to.  A replica that missed fan-outs
+        while draining is healed with one immediate fan-out instead of
+        silently serving stale versions."""
+        with self._sup_lock:
+            if i not in self._draining:
+                raise ValueError(
+                    f"replica {i} is not draining (drain() first)"
+                )
+            self._spawn_replica(i, self.replicas[i].engine,
+                                started=True)
+            if i != self.home:
+                # the engine's content is whatever it drained at —
+                # fan-outs during the drain skipped it on purpose
+                self._replica_gen[i] = self._drain_gen.pop(
+                    i, self._fan_gen
+                )
+            else:
+                self._drain_gen.pop(i, None)
+            self._draining.discard(i)
+        obs.count("serve.fleet.restored", replica=i)
+        if (
+            self._replica_gen[i] < self._fan_gen
+            and self.replicas[self.home].engine.version.host_coo
+            is not None
+        ):
+            self.fan_out()  # catch the restored replica up NOW
+
+    def rolling_restart(self, timeout: float = 30.0) -> int:
+        """Upgrade-style rolling restart: drain + restore each replica
+        in turn, non-home replicas first, the home LAST (its drain
+        flushes the write lane through merge + checkpoint, so the
+        restarted home resumes at a clean frontier).  At most one
+        replica is out of rotation at a time; reads keep serving
+        throughout.  Returns replicas restarted."""
+        order = [
+            i for i in range(len(self.replicas)) if i != self.home
+        ] + [self.home]
+        n = 0
+        for i in order:
+            self.drain(i, timeout=timeout)
+            self.restore(i)
+            n += 1
+        obs.count("serve.fleet.rolling_restarts")
+        return n
 
     # -- lifecycle / introspection -----------------------------------------
 
@@ -297,8 +892,16 @@ class FleetRouter:
         }
 
     def close(self, drain: bool = True, timeout: float = 30.0) -> None:
-        for srv in self.replicas:
-            srv.close(drain=drain, timeout=timeout)
+        self.stop_supervisor(timeout)
+        # non-home replicas first, the home LAST: its close flushes
+        # pending write merges (drain=True), and a fan-out callback
+        # running inside those merges' settle can still swap the
+        # already-stopped replicas' engines consistently
+        order = [
+            i for i in range(len(self.replicas)) if i != self.home
+        ] + [self.home]
+        for i in order:
+            self.replicas[i].close(drain=drain, timeout=timeout)
         if self._scrape is not None:
             from ..obs import export
 
@@ -319,6 +922,16 @@ class FleetRouter:
             "routed": list(self.submitted),
             "spillovers": self.spillovers,
             "fanouts": self.fanouts,
+            "lagging": self.lagging(),
+            "promotions": self.promotions,
+            "replacements": self.replacements,
+            "read_retries": self.read_retries,
+            "draining": sorted(self._draining),
+            "supervisor_alive": (
+                self._sup_thread is not None
+                and self._sup_thread.is_alive()
+            ),
+            "wal_dir": self.wal_dir,
             "per_replica": {
                 i: srv.stats() for i, srv in enumerate(self.replicas)
             },
@@ -327,7 +940,8 @@ class FleetRouter:
     def health(self) -> dict:
         per = {i: srv.health() for i, srv in enumerate(self.replicas)}
         statuses = {h["status"] for h in per.values()}
-        if statuses <= {"ok"}:
+        lagging = self.lagging()
+        if statuses <= {"ok"} and not lagging:
             status = "ok"
         elif "ok" in statuses or "degraded" in statuses:
             status = "degraded"  # something still serves
@@ -341,6 +955,16 @@ class FleetRouter:
             "status": status,
             "replicas": per,
             "home": self.home,
+            # round 16: replicas behind the home's latest fan-out
+            # (failed rebuilds / dead replicas) degrade the fleet
+            # until the next fan-out or the supervisor heals them
+            "lagging": lagging,
+            "draining": sorted(self._draining),
+            "supervisor_alive": (
+                self._sup_thread is not None
+                and self._sup_thread.is_alive()
+            ),
+            "durable": self.wal_dir is not None,
             # fleet-wide SLO budget burn (round 15): worst replica —
             # the pageable number when replicas share one SLO
             "slo_burn": burns,

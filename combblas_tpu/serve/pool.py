@@ -131,14 +131,20 @@ class EnginePool:
                 f"tenant {name!r} needs a positive weight, got {weight}"
             )
         config = config or self.default_config
-        if config.update_autostart:
+        if config.update_autostart or config.wal_dir is None:
             # the POOL worker owns every tenant's write lane (merges
             # charge the WFQ meter); a per-tenant mutation thread
-            # would merge outside the fairness arbiter
+            # would merge outside the fairness arbiter.  An UNSET
+            # wal_dir is pinned to "off" (round 16): N tenants each
+            # resolving one ambient COMBBLAS_WAL would fight over a
+            # single log/snapshot lineage — pool durability must be
+            # an EXPLICIT per-tenant dir on the tenant's config
             import dataclasses
 
             config = dataclasses.replace(
-                config, update_autostart=False
+                config, update_autostart=False,
+                wal_dir="off" if config.wal_dir is None
+                else config.wal_dir,
             )
         with self._lock:
             if name in self._tenants:

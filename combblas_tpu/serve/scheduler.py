@@ -317,6 +317,24 @@ class ServeConfig:
     flight_recorder_events: int = 256
     flight_recorder_dir: str | None = None
     flight_recorder_min_interval_s: float = 1.0
+    # -- durability (round 16; docs/serving.md "Durability &
+    # self-healing").  ``wal_dir`` names the directory holding the
+    # write-ahead log + checkpoints (None resolves ``COMBBLAS_WAL``;
+    # both unset = no durability, the zero-cost default: one attribute
+    # read per write).  Every acknowledged ``submit_update`` appends to
+    # the WAL before its future exists (``wal_fsync``:
+    # arg > ``COMBBLAS_WAL_FSYNC`` > "always"), a background
+    # checkpointer snapshots the served version every
+    # ``checkpoint_every`` merges (arg > ``COMBBLAS_CHECKPOINT_EVERY``
+    # > 8) or ``checkpoint_interval_s`` seconds (None = merge-count
+    # only), atomically, OFF the execution lock, truncating the
+    # replayed WAL prefix and retaining ``checkpoint_retain``
+    # snapshots (arg > ``COMBBLAS_CHECKPOINT_RETAIN`` > 2).
+    wal_dir: str | None = None
+    wal_fsync: str | None = None
+    checkpoint_every: int | None = None
+    checkpoint_interval_s: float | None = None
+    checkpoint_retain: int | None = None
 
     def __post_init__(self):
         if (
@@ -360,6 +378,21 @@ class ServeConfig:
             raise ValueError(
                 "flight_recorder_min_interval_s must be >= 0"
             )
+        if (
+            self.checkpoint_every is not None
+            and self.checkpoint_every < 1
+        ):
+            raise ValueError("checkpoint_every must be >= 1")
+        if (
+            self.checkpoint_interval_s is not None
+            and self.checkpoint_interval_s <= 0
+        ):
+            raise ValueError("checkpoint_interval_s must be > 0")
+        if (
+            self.checkpoint_retain is not None
+            and self.checkpoint_retain < 1
+        ):
+            raise ValueError("checkpoint_retain must be >= 1")
 
     def wait_for(self, kind: str) -> float:
         if self.per_kind_max_wait and kind in self.per_kind_max_wait:
@@ -693,10 +726,11 @@ class Scheduler:
         """Everything still pending, as batches (close/shutdown path)."""
         return self.pop_ready(force=True)
 
-    def fail_pending(self, exc: Exception) -> None:
+    def fail_pending(self, exc: Exception) -> int:
         """Fail every queued request (server shutdown without drain).
         Settlement happens after the lock is released — done-callbacks
-        run synchronously and may re-enter the scheduler."""
+        run synchronously and may re-enter the scheduler.  Returns
+        requests failed (the quarantine accounting, round 16)."""
         drained: list[Request] = []
         with self._lock:
             for q in self._pending.values():
@@ -708,6 +742,7 @@ class Scheduler:
                 # their sampled trace (the write lane's _stop_mutator
                 # convention) — sampled==committed+dropped must hold
                 req.trace.finish(status="aborted", stage="settle")
+        return len(drained)
 
 
 class DeficitRoundRobin:

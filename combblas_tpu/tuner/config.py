@@ -88,6 +88,24 @@ ENV_FLEET_REPLICAS = "COMBBLAS_FLEET_REPLICAS"
 #: tracing (the zero-cost default).
 ENV_OBS_TRACE_SAMPLE = "COMBBLAS_OBS_TRACE_SAMPLE"
 
+#: Round-16 knobs: the serve durability layer (docs/serving.md
+#: "Durability & self-healing").  ``COMBBLAS_WAL`` names the directory
+#: holding the write-ahead log and its checkpoints (unset/0/off = no
+#: durability — the zero-cost default: one attribute read per write);
+#: ``COMBBLAS_WAL_FSYNC`` the append fsync policy (``always`` — every
+#: acknowledged write is on disk before its future exists — or ``off``,
+#: the OS-buffered throughput mode); ``COMBBLAS_CHECKPOINT_EVERY`` the
+#: merge count between automatic background snapshots;
+#: ``COMBBLAS_CHECKPOINT_RETAIN`` how many snapshots are retained (the
+#: corrupt-snapshot fallback depth).
+ENV_WAL = "COMBBLAS_WAL"
+ENV_WAL_FSYNC = "COMBBLAS_WAL_FSYNC"
+ENV_CHECKPOINT_EVERY = "COMBBLAS_CHECKPOINT_EVERY"
+ENV_CHECKPOINT_RETAIN = "COMBBLAS_CHECKPOINT_RETAIN"
+
+#: Valid WAL fsync policies (vetted at the knob, the MERGE precedent).
+WAL_FSYNC_POLICIES = ("always", "off")
+
 #: Round-13 knob: the SpGEMM combine-merge tier (sort | runs | hash) —
 #: how partial-product pieces (3D fiber pieces, 2D ESC stage chunks)
 #: fold into one compacted tile.  Resolution: arg > plan-store record
@@ -121,6 +139,12 @@ DEFAULT_DYNAMIC_HEADROOM = 0.0
 DEFAULT_POOL_BYTE_BUDGET = 0
 DEFAULT_POOL_QUANTUM = 16
 DEFAULT_FLEET_REPLICAS = 2
+#: Durability defaults (round 16): fsync every acknowledged write
+#: (durability-first; ``off`` is the opt-out), snapshot every 8 merges,
+#: retain 2 snapshots (current + the corrupt-fallback predecessor).
+DEFAULT_WAL_FSYNC = "always"
+DEFAULT_CHECKPOINT_EVERY = 8
+DEFAULT_CHECKPOINT_RETAIN = 2
 
 
 def _str_env(name: str) -> str | None:
@@ -297,6 +321,53 @@ def obs_trace_sample(given: float | None = None) -> float:
         v = os.environ.get(ENV_OBS_TRACE_SAMPLE)
         given = float(v) if v else 0.0
     return min(max(float(given), 0.0), 1.0)
+
+
+def wal_dir(given: str | None = None) -> str | None:
+    """The serve durability directory (WAL + checkpoints), or ``None``
+    when durability is disabled: explicit argument >
+    ``COMBBLAS_WAL`` > off.  ``0``/``off``/``none`` (argument or env)
+    disable explicitly — the plan-store convention."""
+    v = os.environ.get(ENV_WAL) if given is None else given
+    if v is None or v.strip().lower() in ("", "0", "off", "none"):
+        return None
+    return os.path.abspath(v)
+
+
+def wal_fsync(given: str | None = None) -> str:
+    """WAL append fsync policy: explicit argument >
+    ``COMBBLAS_WAL_FSYNC`` > ``always``.  A bogus value raises naming
+    the knob (the MERGE/SPMM_BACKEND vetting precedent) instead of
+    surfacing as a silent durability downgrade."""
+    v = _str_env(ENV_WAL_FSYNC) if given is None else given
+    if v is None:
+        return DEFAULT_WAL_FSYNC
+    if v not in WAL_FSYNC_POLICIES:
+        raise ValueError(
+            f"{ENV_WAL_FSYNC} must be one of "
+            f"{'|'.join(WAL_FSYNC_POLICIES)}; got {v!r}"
+        )
+    return v
+
+
+def checkpoint_every(given: int | None = None) -> int:
+    """Merges between automatic background snapshots: explicit
+    argument > ``COMBBLAS_CHECKPOINT_EVERY`` > 8."""
+    if given is not None:
+        return max(int(given), 1)
+    v = _int_env(ENV_CHECKPOINT_EVERY)
+    return DEFAULT_CHECKPOINT_EVERY if v is None else max(v, 1)
+
+
+def checkpoint_retain(given: int | None = None) -> int:
+    """Snapshots retained after an automatic checkpoint: explicit
+    argument > ``COMBBLAS_CHECKPOINT_RETAIN`` > 2.  Clamped >= 1 —
+    retaining zero snapshots would delete the one recovery just
+    needs."""
+    if given is not None:
+        return max(int(given), 1)
+    v = _int_env(ENV_CHECKPOINT_RETAIN)
+    return DEFAULT_CHECKPOINT_RETAIN if v is None else max(v, 1)
 
 
 def dynamic_spill_frac() -> float:

@@ -173,6 +173,75 @@ VERSION_SCHEMA = "combblas_tpu.graph_version/v1"
 _VERSION_MATS = ("E", "E_weighted", "P_ell", "ET")
 
 
+class SnapshotError(ValueError):
+    """A snapshot that must not be loaded: corrupt, truncated, wrong
+    schema, or wrong grid.  The message names the file — and
+    ``load_latest_version`` treats any instance as "fall back to the
+    previous retained snapshot" (round 16)."""
+
+
+def snapshot_name(wal_seq: int) -> str:
+    """Canonical snapshot file name for a version at WAL frontier
+    ``wal_seq``: zero-padded so lexicographic order IS recovery order
+    (``wal_seq`` is a global lineage — monotone across recoveries,
+    unlike per-engine version ids)."""
+    return f"ckpt-{int(wal_seq) + 1:012d}.npz"
+
+
+def snapshot_seq(path: str) -> int:
+    """The ``wal_seq`` stamp encoded in a snapshot's file name (the
+    inverse of ``snapshot_name``; no file read)."""
+    name = os.path.basename(path)
+    return int(name[len("ckpt-"):-len(".npz")]) - 1
+
+
+def list_snapshots(dirpath: str) -> list[str]:
+    """Retained ``save_version`` snapshots in ``dirpath``, OLDEST
+    first (the retention pruner drops a prefix; recovery walks the
+    reverse)."""
+    try:
+        names = os.listdir(dirpath)
+    except OSError:
+        return []
+    return sorted(
+        os.path.join(dirpath, nm) for nm in names
+        if nm.startswith("ckpt-") and nm.endswith(".npz")
+    )
+
+
+def load_latest_version(dirpath: str, grid, *, writable: bool = True):
+    """The newest LOADABLE snapshot in ``dirpath`` as ``(version,
+    path)`` — a corrupt/truncated newest file (the crash-mid-write
+    artifact atomic replace makes rare, or disk damage) falls back to
+    the previous retained snapshot with a warning naming the bad file.
+    Raises ``dynamic.wal.RecoveryError`` when no candidate loads."""
+    import warnings
+
+    candidates = list_snapshots(dirpath)
+    errors = []
+    for path in reversed(candidates):
+        try:
+            return load_version(path, grid, writable=writable), path
+        except SnapshotError as e:
+            errors.append(str(e))
+            from .. import obs
+
+            obs.count("serve.recovery.snapshot_rejected")
+            warnings.warn(
+                f"skipping unloadable snapshot (falling back to the "
+                f"previous retained one): {e}",
+                stacklevel=2,
+            )
+    from ..dynamic.wal import RecoveryError
+
+    raise RecoveryError(
+        f"no loadable GraphVersion snapshot in {dirpath!r} "
+        f"({len(candidates)} candidate(s)"
+        + (f"; errors: {errors}" if errors else "")
+        + ")"
+    )
+
+
 def save_version(path: str, version) -> None:
     """Snapshot a serve ``GraphVersion`` to one self-describing .npz —
     the warm-start half of the replicated fleet (docs/serving.md
@@ -188,6 +257,13 @@ def save_version(path: str, version) -> None:
     regression-tested guarantee.  The host COO/weights ride along when
     the version retained them (``keep_coo=True``), so a restored
     replica can still serve the write lane.
+
+    Round 16 (durability): the write is ATOMIC — the .npz lands in a
+    sibling tmp file and ``os.replace``s into place, so a crash
+    mid-save leaves the previous snapshot intact, never a truncated
+    one under the real name — and the version's WAL position
+    (``version.wal_seq``) is stamped into the meta: recovery replays
+    exactly the log suffix this snapshot does not already contain.
     """
     import time
 
@@ -202,6 +278,7 @@ def save_version(path: str, version) -> None:
         "nnz": int(version.nnz),
         "feat_dim": int(version.feat_dim),
         "headroom": version.headroom,
+        "wal_seq": int(getattr(version, "wal_seq", -1)),
         "grid": [version.E.grid.pr, version.E.grid.pc],
         "mats": {},
     }
@@ -236,24 +313,64 @@ def save_version(path: str, version) -> None:
         arrays["coo_cols"] = np.asarray(cols)
         if version.host_weights is not None:
             arrays["coo_weights"] = np.asarray(version.host_weights)
-    np.savez_compressed(
-        path,
-        __meta__=np.frombuffer(json.dumps(meta).encode(), np.uint8),
-        **arrays,
-    )
+    # atomic: write a sibling tmp (same filesystem — os.replace must
+    # not cross devices) through a FILE OBJECT so np.savez cannot
+    # append its own .npz suffix, fsync, then replace into place
+    tmp = path + ".tmp"
+    try:
+        with open(tmp, "wb") as f:
+            np.savez_compressed(
+                f,
+                __meta__=np.frombuffer(
+                    json.dumps(meta).encode(), np.uint8
+                ),
+                **arrays,
+            )
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
     obs.observe("serve.checkpoint.save_s", time.perf_counter() - t0)
 
 
-def load_version(path: str, grid: Grid):
+def load_version(path: str, grid: Grid, *, writable: bool = True):
     """Restore a ``save_version`` snapshot onto ``grid`` as a
     ``GraphVersion`` ready for ``GraphEngine(grid, version=...)`` or
     ``engine.swap()``.
+
+    ``writable=False`` skips retaining the host bucket arrays the
+    lazy merge-state derivation needs (round 16): a READ-ONLY replica
+    loading a shared snapshot must not pin an O(nnz) host copy of the
+    graph structure it will never merge into — only the write-lane
+    owner (the fleet's home) loads writable.
 
     Same grid shape ONLY (the fleet's replicas share one mesh layout;
     cross-shape restore would re-bucket and forfeit the bit-identical
     shapes the zero-retrace guarantee rests on — rebuild from COO for
     that).  Uploads are one ``device_put`` per persisted array.
+
+    A corrupt or truncated file is REFUSED with a ``SnapshotError``
+    naming it (round 16) — never half-loaded; ``load_latest_version``
+    turns that refusal into a fallback to the previous retained
+    snapshot.
     """
+    try:
+        return _load_version(path, grid, writable)
+    except SnapshotError:
+        raise  # already diagnostic (schema / grid mismatch)
+    except Exception as e:
+        raise SnapshotError(
+            f"refusing corrupt or truncated GraphVersion snapshot "
+            f"{path!r}: {type(e).__name__}: {e}"
+        ) from e
+
+
+def _load_version(path: str, grid: Grid, writable: bool = True):
     import time
 
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -268,18 +385,20 @@ def load_version(path: str, grid: Grid):
     with np.load(path) as z:
         meta = json.loads(bytes(z["__meta__"]).decode())
         if meta.get("v") != VERSION_SCHEMA:
-            raise ValueError(
-                f"not a GraphVersion snapshot (schema {meta.get('v')!r}"
-                f" != {VERSION_SCHEMA!r})"
+            raise SnapshotError(
+                f"{path!r} is not a GraphVersion snapshot (schema "
+                f"{meta.get('v')!r} != {VERSION_SCHEMA!r})"
             )
         pr, pc = meta["grid"]
         if (pr, pc) != (grid.pr, grid.pc):
-            raise ValueError(
+            raise SnapshotError(
                 f"snapshot was taken on a {pr}x{pc} grid; load_version "
                 f"restores onto the SAME grid shape (got {grid.pr}x"
                 f"{grid.pc}) — rebuild from COO to re-shard"
             )
         mats = {}
+        host_mats = {}  # host (bc, bv, br) triples: the merge-state
+        #                 derivation below needs them pre-upload
         for nm in _VERSION_MATS:
             info = meta["mats"].get(nm)
             if info is None:
@@ -291,6 +410,7 @@ def load_version(path: str, grid: Grid):
                 )
                 for i in range(info["nbuckets"])
             ]
+            host_mats[nm] = host_buckets
             mats[nm] = EllParMat.from_host_buckets(
                 grid, host_buckets, info["nrows"], info["ncols"]
             )
@@ -337,7 +457,35 @@ def load_version(path: str, grid: Grid):
             X=X,
             feat_dim=meta["feat_dim"],
             headroom=meta["headroom"],
+            wal_seq=int(meta.get("wal_seq", -1)),
         )
+        if host_coo is not None and writable:
+            # round 16: the merge state must describe the RESTORED
+            # bucket layout, sticky slots included — a later
+            # apply_delta that bootstrapped a fresh host_build from
+            # the COO would patch against the wrong slot map and
+            # corrupt the graph (snapshots of incrementally merged
+            # versions drift from fresh builds by design).  Derived
+            # LAZILY (apply_delta consumes ``dyn_source`` on the
+            # first merge): read-only replicas loading the same
+            # snapshot must not each pay the O(nnz log nnz) key sort
+            # and bucket copies — only the write-lane owner merges.
+            e_buckets = host_mats["E"]
+            t_buckets = host_mats.get("ET")
+            deg_host = np.asarray(z["deg"])
+            outdeg_host = (
+                np.asarray(z["outdeg"]) if "outdeg" in z else None
+            )
+
+            def _dyn_source():
+                from ..dynamic.merge import state_from_host_buckets
+
+                return state_from_host_buckets(
+                    grid, e_buckets, t_buckets, host_coo,
+                    host_weights, deg_host, outdeg_host,
+                )
+
+            version.dyn_source = _dyn_source
     obs.observe("serve.checkpoint.load_s", time.perf_counter() - t0)
     return version
 

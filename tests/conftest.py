@@ -39,6 +39,17 @@ os.environ["COMBBLAS_POOL_BYTE_BUDGET"] = "0"
 os.environ["COMBBLAS_POOL_QUANTUM"] = "0"
 os.environ["COMBBLAS_FLEET_REPLICAS"] = "0"
 
+# Hermetic durability knobs (round 16): an ambient COMBBLAS_WAL would
+# attach a write-ahead log + bootstrap checkpoint to EVERY server any
+# tier-1 test builds (extra files, extra fsyncs, rerouted recovery
+# semantics) — durability under test must come from explicit
+# ServeConfig(wal_dir=...) arguments, so the env knobs are pinned to
+# their defaults ("0"/"" = default per the tuner/config convention).
+os.environ["COMBBLAS_WAL"] = "0"
+os.environ["COMBBLAS_WAL_FSYNC"] = ""
+os.environ["COMBBLAS_CHECKPOINT_EVERY"] = "0"
+os.environ["COMBBLAS_CHECKPOINT_RETAIN"] = "0"
+
 # Hermetic trace sampling (round 15): an ambient
 # COMBBLAS_OBS_TRACE_SAMPLE would make every obs-enabled serve test
 # also record per-request traces (and their ``serve.trace.sampled``

@@ -83,6 +83,45 @@ def test_dynamic_knobs_centralized():
     assert config.store_compact_min() >= 1
 
 
+def test_durability_knobs_centralized(monkeypatch, tmp_path):
+    """The round-16 durability knobs parse through tuner/config with
+    the shared conventions: unset/"0"/"off" disable the WAL dir,
+    explicit argument beats the env, a bogus fsync policy raises
+    NAMING the knob, and the integer knobs clamp sane."""
+    import pytest
+
+    from combblas_tpu.tuner import config
+
+    for name in (
+        config.ENV_WAL, config.ENV_WAL_FSYNC,
+        config.ENV_CHECKPOINT_EVERY, config.ENV_CHECKPOINT_RETAIN,
+    ):
+        assert name.startswith("COMBBLAS_")
+    # conftest pins these to defaults: durability off, fsync always
+    assert config.wal_dir() is None
+    assert config.wal_fsync() == config.DEFAULT_WAL_FSYNC == "always"
+    assert config.checkpoint_every() == config.DEFAULT_CHECKPOINT_EVERY
+    assert (
+        config.checkpoint_retain() == config.DEFAULT_CHECKPOINT_RETAIN
+    )
+    monkeypatch.setenv(config.ENV_WAL, str(tmp_path))
+    monkeypatch.setenv(config.ENV_WAL_FSYNC, "off")
+    monkeypatch.setenv(config.ENV_CHECKPOINT_EVERY, "3")
+    monkeypatch.setenv(config.ENV_CHECKPOINT_RETAIN, "5")
+    assert config.wal_dir() == str(tmp_path)
+    assert config.wal_fsync() == "off"
+    assert config.checkpoint_every() == 3
+    assert config.checkpoint_retain() == 5
+    # argument > env; "off"/"0" disable explicitly; vetting raises
+    assert config.wal_dir("off") is None
+    assert config.wal_dir("0") is None
+    assert config.wal_fsync("always") == "always"
+    assert config.checkpoint_every(1) == 1
+    assert config.checkpoint_retain(0) == 1  # clamped: retain >= 1
+    with pytest.raises(ValueError, match=config.ENV_WAL_FSYNC):
+        config.wal_fsync("sometimes")
+
+
 def test_pool_fleet_knobs_centralized(monkeypatch):
     """The round-14 pool/fleet knobs parse through tuner/config with
     the shared conventions (unset/empty/"0" = default; explicit

@@ -711,3 +711,94 @@ def test_round14_pool_fleet_counters_gated(rng, tmp_path):
     finally:
         obs.disable()
         obs.reset()
+
+
+def test_round16_durability_counters_gated(rng, tmp_path):
+    """ISSUE 14 satellite: the round-16 durability & self-healing
+    series — WAL appends/truncates, checkpoint reasons, recovery
+    replay counters, fleet versions_behind — are emitted under obs and
+    cost NOTHING when disabled (one attribute read on every hot
+    path)."""
+    import os
+
+    from combblas_tpu.dynamic import open_wal, recover_version
+    from combblas_tpu.parallel.grid import Grid
+    from combblas_tpu.serve import FleetRouter, GraphEngine, \
+        Server, ServeConfig
+
+    grid = Grid.make(1, 1)
+    n = 32
+    r = rng.integers(0, n, 120)
+    c = rng.integers(0, n, 120)
+    rows = np.concatenate([r, c])
+    cols = np.concatenate([c, r])
+    present = set(zip(rows.tolist(), cols.tolist()))
+    pairs = [
+        (i, j) for i in range(n) for j in range(i + 1, n)
+        if (i, j) not in present and (j, i) not in present
+    ][:2]
+
+    def exercise(tag):
+        d = os.path.join(tmp_path, f"wal-{tag}")
+        cfg = ServeConfig(lane_widths=(1,), update_autostart=False,
+                          update_flush=1, wal_dir=d,
+                          # retain only the newest snapshot so the
+                          # manual checkpoint actually truncates
+                          # (default retain=2 keeps the bootstrap
+                          # snapshot, whose seq pins the WAL suffix)
+                          checkpoint_retain=1)
+        eng = GraphEngine.from_coo(
+            grid, rows, cols, n, kinds=("bfs",), keep_coo=True
+        )
+        srv = Server(eng, cfg)  # bootstrap checkpoint
+        (a, b), (a2, b2) = pairs
+        f = srv.submit_update([("insert", a, b), ("insert", b, a)])
+        srv.pump_updates(force=True)
+        assert f.exception(timeout=0) is None
+        srv.checkpoint_now()  # truncates the replayed WAL prefix
+        wal = open_wal(d)
+        recover_version(d, wal, grid, kinds=("bfs",))
+        wal.close()
+        srv.scheduler.close()
+        # fleet surface: fan-out generation gauges
+        fr = FleetRouter.build(
+            grid, rows, cols, n, replicas=2, kinds=("bfs",),
+            config=ServeConfig(lane_widths=(1,), update_flush=1,
+                               update_max_delay_s=0.005),
+            start=False,
+        )
+        fr.replicas[0].submit_update(
+            [("insert", a2, b2), ("insert", b2, a2)]
+        )
+        fr.replicas[0].pump_updates(force=True)
+        fr.fan_out()
+        fr.close(drain=False)
+
+    assert not obs.ENABLED
+    exercise("off")
+    assert obs.registry.empty()  # disabled: zero bookkeeping
+
+    obs.enable(install_hooks=False)
+    try:
+        obs.reset()
+        exercise("on")
+        g = obs.registry.get_counter
+        assert g("serve.wal.appends") == 1  # the acknowledged write
+        assert obs.registry.get_histogram(
+            "serve.wal.append_s"
+        )["count"] == 1
+        assert g("serve.wal.truncated") >= 1
+        assert g("serve.checkpoint.auto", reason="bootstrap") == 1
+        assert g("serve.checkpoint.auto", reason="manual") == 1
+        assert g("serve.recovery.runs") == 1
+        assert g("serve.recovery.replayed_ops") == 0  # ckpt covered it
+        assert obs.registry.get_histogram(
+            "serve.recovery.recover_s"
+        )["count"] == 1
+        assert obs.registry.get_gauge(
+            "serve.fleet.versions_behind", replica=1
+        ) == 0
+        assert g("serve.fleet.fanout") == 1
+    finally:
+        obs.disable()
+        obs.reset()
