@@ -80,6 +80,19 @@ writes lost (every acked edge present in the crash-recovered state),
 recovered state bit-exact (``recover_version`` vs the surviving home,
 ``to_host_coo`` equal), and 0 post-recovery retraces across the healed
 fleet.  Results under benchmarks/results/r16/.
+
+BENCH_FLEET=process upgrades the recovery scenario to the PROCESS
+fleet (round 17, ISSUE 15): replicas are real OS subprocesses
+(``serve.ProcessFleet``) and the kills are real ``SIGKILL``s fired
+through the scripted ``ProcessFaultPlan`` — a non-home replica first,
+then the HOME mid-stream (promotion at the WAL frontier over IPC) —
+followed by a ``SIGSTOP`` hang phase: the stopped replica must be
+detected by HEARTBEAT TIMEOUT and routed around (reads keep serving)
+rather than wedging the router.  Same four gates as the thread
+scenario, plus the first honest replica-parallelism measurement:
+read-only throughput through N subprocess replicas (own JAX runtimes,
+no shared exec lock) vs the SAME stream through the thread fleet's
+shared-lock serialization.  Results under benchmarks/results/r17/.
 """
 
 from __future__ import annotations
@@ -1119,6 +1132,275 @@ def run_recovery(scale: int = SCALE, edgefactor: int = EDGEFACTOR,
     return out
 
 
+def _read_burst_qps(router, stream, timeout=120.0) -> float:
+    """Read-only throughput through a fleet front door: submit the
+    whole stream, wait for every future — wall-clock covers admission
+    through settle (the replica-parallelism measurement's probe)."""
+    t0 = time.perf_counter()
+    futs = [router.submit(kind, root) for kind, root in stream]
+    for f in futs:
+        f.result(timeout=timeout)
+    return len(futs) / (time.perf_counter() - t0)
+
+
+def run_recovery_process(scale: int = SCALE,
+                         edgefactor: int = EDGEFACTOR,
+                         kinds=("bfs", "pagerank")) -> dict:
+    """BENCH_SERVE_RECOVERY=1 BENCH_FLEET=process — the kill-storm
+    over REAL crash domains (module docstring): scripted SIGKILLs
+    (non-home, then the home mid-stream), a SIGSTOP hang phase, and
+    the N-process vs thread-fleet read-throughput comparison."""
+    import signal
+    import tempfile
+    import threading
+
+    import numpy as np
+
+    from combblas_tpu import obs
+    from combblas_tpu.dynamic import open_wal, recover_version
+    from combblas_tpu.parallel.grid import Grid
+    from combblas_tpu.serve import (
+        FleetRouter,
+        ProcessFleet,
+        ServeConfig,
+    )
+    from combblas_tpu.utils import checkpoint
+
+    sidecar = obs.enable_sidecar("serve-recovery-process")
+    nreplicas = max(int(os.environ.get("BENCH_FLEET_REPLICAS", "3")), 2)
+    nqueries = int(os.environ.get("BENCH_SERVE_QUERIES", "400"))
+    nwrites = int(os.environ.get("BENCH_RECOVERY_WRITES", "24"))
+    nburst = int(os.environ.get("BENCH_PROC_BURST", "200"))
+    work = tempfile.mkdtemp(prefix="combblas-procfleet-")
+    wal_dir = os.path.join(work, "wal")
+
+    n = 1 << scale
+    from combblas_tpu.utils.rmat import rmat_symmetric_coo_host
+
+    rows, cols = rmat_symmetric_coo_host(42, scale, edgefactor)
+    # per-replica 1x1 mesh: each subprocess owns its whole runtime,
+    # and the thread-fleet comparator shares ONE 1x1 grid — the
+    # difference under the burst is exactly the shared exec lock
+    grid = Grid.make(1, 1)
+    deg = np.bincount(rows, minlength=n)
+    rng = np.random.default_rng(7)
+    roots = rng.choice(np.flatnonzero(deg > 0), size=nqueries)
+    stream = [
+        (kinds[i % len(kinds)], int(r)) for i, r in enumerate(roots)
+    ]
+    burst = [("bfs", int(r)) for r in roots[:nburst]]
+    present = set(zip(rows.tolist(), cols.tolist()))
+    pool = rng.permutation(n).tolist()
+    pairs = []
+    for a, b in zip(pool[0::2], pool[1::2]):
+        if a != b and (a, b) not in present and (b, a) not in present:
+            pairs.append((int(a), int(b)))
+        if len(pairs) >= nwrites:
+            break
+
+    cfg = ServeConfig(
+        lane_widths=(1, 2, 4, 8, 16),
+        max_queue=max(64, nqueries), max_wait_s=0.005,
+        update_flush=2, update_max_delay_s=0.01,
+    )
+
+    # -- comparator: the SAME burst through the thread fleet's
+    #    shared-lock serialization (no WAL: read-only probe)
+    tfr = FleetRouter.build(
+        grid, rows, cols, n, replicas=nreplicas, config=cfg,
+        kinds=kinds,
+    )
+    tfr.warmup()
+    thread_qps = _read_burst_qps(tfr, burst)
+    tfr.close(drain=False)
+
+    t0 = time.perf_counter()
+    fr = ProcessFleet.build(
+        (1, 1), rows, cols, n, replicas=nreplicas, config=cfg,
+        kinds=kinds, wal_dir=wal_dir,
+        workdir=os.path.join(work, "proc"),
+        hb_interval_s=0.1, hb_timeout_s=2.0,
+        from_coo_kw={"headroom": 0.5},
+    )
+    load_s = time.perf_counter() - t0
+    proc_qps = _read_burst_qps(fr, burst)
+    fr.start_supervisor(interval_s=0.02)
+
+    acked: list = []
+    write_failures = 0
+
+    def writer():
+        nonlocal write_failures
+        for a, b in pairs:
+            try:
+                fr.submit_update(
+                    [("insert", a, b), ("insert", b, a)]
+                ).result(timeout=120)
+                acked.append((a, b))
+            except Exception:
+                # a write rejected / failed at a kill boundary was
+                # never CONFIRMED merged: it may still be durable
+                # (WAL-appended) — allowed, but not counted acked
+                write_failures += 1
+            time.sleep(0.002)
+
+    # scripted REAL signals at routed-submit indices: a non-home
+    # SIGKILL first, then the home ("home" resolves at fire time —
+    # the promotion scenario)
+    fr.proc_faults.sigkill(nqueries // 3,
+                           replica=(fr.home + 1) % nreplicas)
+    fr.proc_faults.sigkill((2 * nqueries) // 3, replica="home")
+
+    ok = failed = 0
+    lat: list[float] = []
+    t0 = time.perf_counter()
+    wt = threading.Thread(target=writer)
+    wt.start()
+    for kind, root in stream:
+        ts = time.monotonic()
+        try:
+            fr.submit(kind, root).result(timeout=120)
+            lat.append(time.monotonic() - ts)
+            ok += 1
+        except Exception:
+            failed += 1
+    wt.join(300)
+    wall_s = time.perf_counter() - t0
+    deadline = time.monotonic() + 60
+    while (
+        fr._needs_rebuild
+        or any(fr._dead(i) for i in range(nreplicas))
+    ) and time.monotonic() < deadline:
+        time.sleep(0.02)
+    availability = ok / nqueries
+
+    # -- SIGSTOP hang phase: alive-but-silent must be DETECTED by
+    #    heartbeat timeout and routed around, never wedging the router
+    victim = (fr.home + 1) % nreplicas
+    os.kill(fr.replicas[victim].proc.pid, signal.SIGSTOP)
+    stop_ok = 0
+    t_stop = time.monotonic()
+    detected_s = None
+    while time.monotonic() - t_stop < 30:
+        try:
+            fr.submit("bfs", int(roots[0])).result(timeout=120)
+            stop_ok += 1
+        except Exception:
+            pass
+        if detected_s is None and fr.replicas[victim].quarantined:
+            detected_s = time.monotonic() - t_stop
+        if detected_s is not None:
+            break
+        time.sleep(0.05)
+    sigstop_detected = detected_s is not None
+    deadline = time.monotonic() + 60
+    while (
+        fr._needs_rebuild
+        or any(fr._dead(i) for i in range(nreplicas))
+    ) and time.monotonic() < deadline:
+        time.sleep(0.02)
+
+    # -- gate: 0 post-recovery retraces across the healed fleet ----------
+    marks = fr.trace_marks()
+    for kind in kinds:
+        for i, rp in enumerate(fr.replicas):
+            if rp.is_serving():
+                rp.submit(kind, int(roots[0])).result(timeout=120)
+    post_retraces = fr.retraces_since(marks)
+
+    # -- gates: recovery bit-exact vs a SURVIVOR + zero acked loss -------
+    survivor_spool = os.path.join(work, "survivor.npz")
+    fr.replicas[fr.home].call(
+        "spool_version", {"path": survivor_spool}, timeout_s=120
+    )
+    stats = fr.stats()
+    fr.close(drain=True)
+    survivor = checkpoint.load_version(survivor_spool, grid,
+                                       writable=False)
+    wal = open_wal(wal_dir)
+    recovered = recover_version(wal_dir, wal, grid, kinds=kinds)
+    wal.close()
+    hr, hc, hv = survivor.E.to_host_coo()
+    rr, rc_, rv = recovered.E.to_host_coo()
+    bit_exact = (
+        np.array_equal(np.asarray(hr), np.asarray(rr))
+        and np.array_equal(np.asarray(hc), np.asarray(rc_))
+        and np.array_equal(np.asarray(hv), np.asarray(rv))
+    )
+    have = set(zip(rr.tolist(), rc_.tolist()))
+    lost = [
+        p for p in acked
+        if p not in have or (p[1], p[0]) not in have
+    ]
+
+    out = {
+        "metric": "serve_recovery_process_availability",
+        "unit": "fraction_ok",
+        "value": round(availability, 4),
+        "availability_pct": round(100 * availability, 2),
+        "ok": bool(
+            availability >= 0.95
+            and not lost
+            and bit_exact
+            and post_retraces == 0
+            and sigstop_detected
+            and stats["promotions"] >= 1
+            and stats["replacements"] >= 3  # 2 SIGKILLs + SIGSTOP
+        ),
+        "fleet": "process",
+        "nqueries": nqueries,
+        "reads_ok": ok,
+        "reads_failed": failed,
+        "read_retries": stats["read_retries"],
+        "writes_acked": len(acked),
+        "write_failures": write_failures,
+        "acked_writes_lost": len(lost),
+        "recovered_bit_exact": bit_exact,
+        "post_recovery_retraces": post_retraces,
+        "sigkills": stats["sigkills"],
+        "sigstop_detected": sigstop_detected,
+        "sigstop_detect_s": (
+            round(detected_s, 3) if detected_s is not None else None
+        ),
+        "sigstop_reads_served": stop_ok,
+        "promotions": stats["promotions"],
+        "replacements": stats["replacements"],
+        "respawn_failures": stats["respawn_failures"],
+        "ipc_timeouts": sum(
+            r["ipc_timeouts"] for r in stats["per_replica"].values()
+        ),
+        "final_home": stats["home"],
+        "p50_ms": round(1e3 * _percentile(lat, 0.50), 2) if lat else None,
+        "p99_ms": round(1e3 * _percentile(lat, 0.99), 2) if lat else None,
+        "qps_under_kills": round(nqueries / wall_s, 2),
+        # the replica-parallelism headline: N processes (own runtimes)
+        # vs N threads behind one shared exec lock, same read burst.
+        # READ WITH cpus: on a single-core image the processes cannot
+        # physically parallelize, so the ratio measures the ISOLATION
+        # TAX (IPC round trip + result copy); the parallel win needs
+        # per-replica silicon (the multi-chip follow-up).
+        "read_qps_process": round(proc_qps, 2),
+        "read_qps_thread": round(thread_qps, 2),
+        "parallel_speedup": round(proc_qps / thread_qps, 2),
+        "cpus": os.cpu_count(),
+        "recovered_nnz": int(len(rr)),
+        "replicas": nreplicas,
+        "scale": scale,
+        "grid": [1, 1],
+        "kinds": list(kinds),
+        "load_s": round(load_s, 2),
+        "wall_s": round(wall_s, 2),
+        "wal_dir": wal_dir,
+    }
+    obs.gauge("serve.bench.recovery_availability", availability)
+    if sidecar:
+        try:
+            out["obs_jsonl"] = obs.dump_jsonl()
+        except Exception as e:  # telemetry must never fail the bench
+            out["obs_error"] = str(e)
+    return out
+
+
 def _emit_pool_summary(out: dict) -> int:
     """The bench headline contract (bench.py ``emit_summary``) for the
     standalone pool scenario: a compact truncation-proof final stdout
@@ -1163,7 +1445,10 @@ def main():
     elif os.environ.get("BENCH_SERVE_MUTATE") == "1":
         out = run_mutate()
     elif os.environ.get("BENCH_SERVE_RECOVERY") == "1":
-        out = run_recovery()
+        if os.environ.get("BENCH_FLEET") == "process":
+            out = run_recovery_process()
+        else:
+            out = run_recovery()
     else:
         out = run()
     print(json.dumps(out), flush=True)

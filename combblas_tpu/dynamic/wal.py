@@ -109,7 +109,18 @@ class WriteAheadLog:
         self.truncated_records = 0
         for rec in self._read_records():
             self._position = max(self._position, _rec_last(rec))
-        self._fh = open(self.path, "a", encoding="utf-8")
+        self._fd = self._open_append()
+
+    def _open_append(self) -> int:
+        """O_APPEND fd: every record goes down as ONE ``os.write`` of
+        one whole line (round 17) — the kernel's atomic append seek
+        means two PROCESSES sharing a log (or a log file a sibling
+        still holds open across a failover) can never interleave
+        bytes mid-line; the property test in
+        tests/test_append_atomicity.py pins this."""
+        return os.open(
+            self.path, os.O_APPEND | os.O_CREAT | os.O_WRONLY, 0o644
+        )
 
     # -- write side --------------------------------------------------------
 
@@ -142,15 +153,27 @@ class WriteAheadLog:
         })
 
     def _append_rec(self, rec: dict) -> int:
-        line = json.dumps(rec, separators=(",", ":")) + "\n"
+        data = (json.dumps(rec, separators=(",", ":")) + "\n").encode(
+            "utf-8"
+        )
         last = _rec_last(rec)
         t0 = time.perf_counter()
         with self._lock:
-            off = self._fh.tell()
-            self._fh.write(line)
-            self._fh.flush()
+            if self._fd is None:
+                raise ValueError("WAL is closed")
+            off = os.lseek(self._fd, 0, os.SEEK_END)
+            # ONE write syscall for the whole line (the O_APPEND
+            # atomicity contract); a partial count (ENOSPC et al)
+            # leaves a torn tail the loader skips — surface it as an
+            # append failure so the write is REJECTED, never
+            # acknowledged half-durable
+            n = os.write(self._fd, data)
+            if n != len(data):
+                raise OSError(
+                    f"short WAL append ({n}/{len(data)} bytes)"
+                )
             if self.fsync == "always":
-                os.fsync(self._fh.fileno())
+                os.fsync(self._fd)
             self._position = max(self._position, int(last))
             self.appended += 1
         obs.count("serve.wal.appends")
@@ -319,18 +342,23 @@ class WriteAheadLog:
                     f.write("\n")
                 f.flush()
                 os.fsync(f.fileno())
-            self._fh.close()
+            os.close(self._fd)
+            # None across the gap: if the reopen below fails
+            # (EMFILE, permissions), a later append must fail-stop
+            # ("WAL is closed") rather than os.write through a stale
+            # descriptor number another file may have reused
+            self._fd = None
             os.replace(tmp, self.path)
-            self._fh = open(self.path, "a", encoding="utf-8")
+            self._fd = self._open_append()
             self.truncated_records += dropped
         obs.count("serve.wal.truncated", dropped)
         return dropped
 
     def close(self) -> None:
         with self._lock:
-            if self._fh is not None:
-                self._fh.close()
-                self._fh = None
+            if self._fd is not None:
+                os.close(self._fd)
+                self._fd = None
 
     def stats(self) -> dict:
         with self._lock:

@@ -769,6 +769,114 @@ name                                      kind       meaning
                                                      the per-replica
                                                      pair)
 ========================================  =========  ==================
+
+Process-fleet series (round 17 — subprocess replicas with real crash
+domains; docs/serving.md "Process fleet").  The shared policy layer
+(``serve/policy.py``) emits the routing/supervision disposition under
+the fleet's own prefix, so ``serve.procfleet.submitted`` /
+``.spillover`` / ``.read_retry`` / ``.supervisor`` are the
+``serve.fleet.*`` rows above with processes instead of threads; the
+rows below are process-specific:
+
+========================================  =========  ==================
+name                                      kind       meaning
+========================================  =========  ==================
+``serve.procfleet.replicas``              gauge      subprocess replica
+                                                     count behind the
+                                                     router
+``serve.procfleet.heartbeat_age_s``       gauge      seconds since a
+                                                     replica's last
+                                                     heartbeat (labels
+                                                     ``replica``) —
+                                                     the HANG detector:
+                                                     a SIGSTOPped
+                                                     process is alive
+                                                     but silent, and
+                                                     past the timeout
+                                                     it is quarantined
+                                                     and routed around
+``serve.procfleet.rpc_latency_s``         histogram  per-RPC round-trip
+                                                     over the framed
+                                                     IPC channel
+                                                     (labels ``op``)
+``serve.procfleet.ipc_timeouts``          counter    RPCs that ran out
+                                                     their per-request
+                                                     deadline (labels
+                                                     ``op``) — futures
+                                                     fail; the router
+                                                     never wedges on a
+                                                     hung replica
+``serve.procfleet.quarantined``           counter    replica processes
+                                                     taken out of
+                                                     service (in-flight
+                                                     futures failed
+                                                     honestly, process
+                                                     SIGKILLed; labels
+                                                     ``replica``)
+``serve.procfleet.respawns``              counter    replacement
+                                                     subprocesses
+                                                     booted warm from
+                                                     checkpoint+WAL
+                                                     (labels
+                                                     ``replica``)
+``serve.procfleet.respawn_failed``        counter    failed respawn
+                                                     attempts — the
+                                                     fleet keeps
+                                                     serving degraded
+                                                     on survivors with
+                                                     capped-backoff
+                                                     retry (labels
+                                                     ``replica``)
+``serve.procfleet.promotions``            counter    dead-home
+                                                     promotions at the
+                                                     WAL frontier, over
+                                                     IPC
+``serve.procfleet.sigkills`` /            counter    scripted
+``serve.procfleet.sigstops``                         ``ProcessFaultPlan``
+                                                     signals fired at
+                                                     replica processes
+                                                     (labels
+                                                     ``replica``)
+``serve.procfleet.fanout``                counter    home-merge version
+                                                     fan-outs (spooled
+                                                     checkpoint file +
+                                                     per-replica
+                                                     ``swap_from_
+                                                     checkpoint``)
+``serve.procfleet.fanout_s``              histogram  wall time of one
+                                                     full fan-out
+                                                     (spool + swaps)
+``serve.procfleet.fanout_failed``         counter    per-replica swap
+                                                     failures inside a
+                                                     fan-out — the
+                                                     replica lags, the
+                                                     fleet continues
+                                                     (labels
+                                                     ``replica``)
+``serve.procfleet.versions_behind``       gauge      fan-out
+                                                     generations a
+                                                     replica lags the
+                                                     home (labels
+                                                     ``replica``)
+``tuner.store.compact_skipped``           counter    plan-store
+                                                     compactions
+                                                     skipped on
+                                                     advisory-lock
+                                                     contention (a
+                                                     sibling process
+                                                     is compacting) —
+                                                     the next loader
+                                                     compacts instead
+``tuner.store.append_unfenced``           counter    plan appends that
+                                                     proceeded without
+                                                     the shared fence
+                                                     after the bounded
+                                                     non-blocking
+                                                     retries (a wedged
+                                                     lock holder must
+                                                     never hang the
+                                                     write path)
+========================================  =========  ==================
 """
 
 from __future__ import annotations

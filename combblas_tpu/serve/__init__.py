@@ -49,6 +49,19 @@ batches". Four layers (docs/serving.md has the full architecture):
    substrate (``dynamic/wal.py`` WAL + ``Server``'s background
    checkpointer + ``from_recovery``) is docs/serving.md "Durability &
    self-healing".
+8. **procfleet** (`procfleet.py` + `_procworker.py` + `ipc.py` +
+   `policy.py`, round 17) — ``ProcessFleet``: the same fleet with
+   REAL crash domains — each replica is an OS subprocess hosting a
+   ``Server`` on its own JAX runtime (no shared exec lock: honest
+   replica parallelism) behind a length-prefixed JSON IPC channel
+   with per-request deadlines.  Routing/supervision policy is shared
+   with ``FleetRouter`` via ``policy.py``; liveness is process-level
+   (``Popen.poll``, broken pipe, heartbeat timeout — a SIGSTOPped
+   replica is detected as a HANG and routed around), replacements
+   respawn warm from checkpoint+WAL, the dead-home promotion happens
+   over IPC at the WAL frontier, versions fan out as checkpoint
+   files (never pickled arrays), and ``ProcessFaultPlan`` scripts
+   real SIGKILL/SIGSTOP chaos deterministically.
 
 Everything is wired into ``combblas_tpu.obs`` (queue-depth gauge,
 occupancy/padding-waste/latency histograms, plan-cache and
@@ -58,7 +71,12 @@ against the one-call-per-query baseline.
 
 from .batcher import Request, assemble, bucket_width, scatter
 from .engine import KINDS, GraphEngine, GraphVersion
-from .faults import FAULT_POINTS, FaultInjector, InjectedFault
+from .faults import (
+    FAULT_POINTS,
+    FaultInjector,
+    InjectedFault,
+    ProcessFaultPlan,
+)
 from .scheduler import (
     BackpressureError,
     CircuitBreaker,
@@ -70,6 +88,7 @@ from .scheduler import (
 from .api import Server
 from .pool import EnginePool, PoolServer
 from .fleet import FleetRouter, ReplicaDeadError
+from .procfleet import IpcTimeoutError, ProcessFleet, ReplicaProc
 from .slo import ErrorBudget
 
 __all__ = [
@@ -77,7 +96,9 @@ __all__ = [
     "BackpressureError", "CircuitBreaker", "CircuitBreakerOpen",
     "DeficitRoundRobin", "EnginePool", "PoolServer", "FleetRouter",
     "ReplicaDeadError",
-    "FaultInjector", "InjectedFault", "FAULT_POINTS", "ErrorBudget",
+    "ProcessFleet", "ReplicaProc", "IpcTimeoutError",
+    "FaultInjector", "InjectedFault", "ProcessFaultPlan",
+    "FAULT_POINTS", "ErrorBudget",
     "Request", "KINDS",
     "bucket_width", "assemble", "scatter",
 ]

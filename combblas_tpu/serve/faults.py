@@ -229,3 +229,80 @@ class FaultInjector:
                 "calls": dict(self.calls),
                 "fired": dict(self.fired),
             }
+
+
+class ProcessFaultPlan:
+    """Deterministic PROCESS-level chaos (round 17, the process
+    fleet): scripted real signals against replica subprocesses,
+    keyed by the fleet's routed-submit call index so a chaos run
+    replays bit-for-bit — the ``FaultInjector`` philosophy lifted to
+    OS crash domains.
+
+    Two failure modes, because they fail DIFFERENTLY:
+
+    * ``sigkill(at, replica)`` — instant crash: the process exits,
+      the channel breaks, ``Popen.poll()`` reports it; supervision
+      sees it within one tick.
+    * ``sigstop(at, replica)`` — a HANG, not a death: the process
+      stays alive and the socket stays open, but heartbeats stop and
+      in-flight RPCs run out their deadlines; only the heartbeat
+      timeout can catch it.  ``sigcont(at, replica)`` un-wedges (for
+      tests that assert a stalled replica is routed around and then
+      recovers — though quarantine's SIGKILL usually collapses it
+      first).
+
+    ``replica`` is an index or ``"home"`` (resolved at FIRE time —
+    after a promotion, "home" tracks the lineage, which is what a
+    kill-the-home chaos scenario means).  ``ProcessFleet.submit``
+    calls :meth:`step` once per routed query and applies what is due.
+
+    Unarmed cost: one attribute read per routed submit.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rules: list[tuple[int, str, object]] = []  # (at, sig, replica)
+        self._armed = False
+        self.calls = 0
+        self.fired: list[tuple[int, str, object]] = []
+
+    def _add(self, at: int, sig: str, replica) -> "ProcessFaultPlan":
+        with self._lock:
+            self._rules.append((int(at), sig, replica))
+            self._armed = True
+        return self
+
+    def sigkill(self, at: int, replica="home") -> "ProcessFaultPlan":
+        """SIGKILL ``replica`` at routed-submit call index ``at``."""
+        return self._add(at, "SIGKILL", replica)
+
+    def sigstop(self, at: int, replica="home") -> "ProcessFaultPlan":
+        """SIGSTOP (wedge, do not kill) ``replica`` at call ``at``."""
+        return self._add(at, "SIGSTOP", replica)
+
+    def sigcont(self, at: int, replica="home") -> "ProcessFaultPlan":
+        return self._add(at, "SIGCONT", replica)
+
+    def step(self) -> list[tuple[str, object]]:
+        """Advance one routed-submit call; returns the ``(signal,
+        replica)`` actions due at this index, in arming order."""
+        if not self._armed:
+            return []
+        with self._lock:
+            call = self.calls
+            self.calls += 1
+            due = [
+                (sig, rep) for at, sig, rep in self._rules
+                if at == call
+            ]
+            for d in due:
+                self.fired.append((call, *d))
+            return due
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "rules": list(self._rules),
+                "calls": self.calls,
+                "fired": list(self.fired),
+            }

@@ -43,38 +43,35 @@ Properties that make it more than a load balancer:
   execution-side are retried (bounded, reads only) on the next-best
   replica.
 
-Reads route to the least-loaded SERVING replica (queue depth,
-round-robin tie break; dead/closed/draining replicas attract no
-traffic) and SPILL OVER on backpressure: only when every replica
-rejects does the caller see the last ``BackpressureError``.
+Round 17: the routing / read-retry / supervision policy moved to
+``serve/policy.py`` (:class:`~combblas_tpu.serve.policy.ReplicaFleetBase`)
+so the PROCESS fleet (``serve/procfleet.py`` — replicas as real OS
+subprocesses with their own JAX runtimes) shares it instead of forking
+it.  This class keeps the thread-hosted specifics: worker-thread death
+detection, in-process rebuild/promotion, the shared exec lock.
 
 Thread-hosted replicas: each ``Server`` owns its own engine, queue,
 breakers and worker thread inside this process — the honest analog of
 a replica fleet on the tier-1 virtual mesh, and exactly what one host
 of a multi-host fleet runs per chip.  "Replica death" is worker-thread
-death (the ``replica.death`` fault point); a real multi-process fleet
-swaps thread liveness for process liveness and keeps everything else.
+death (the ``replica.death`` fault point); the multi-process fleet
+(``procfleet.py``) swaps thread liveness for process liveness and
+keeps everything else.
 """
 
 from __future__ import annotations
 
 import dataclasses
-import itertools
 import threading
 import time
-from concurrent.futures import Future
 
 from .. import obs
 from .batcher import settle
 from .faults import FaultInjector
-from .scheduler import BackpressureError, ServeConfig
+from .policy import ReplicaDeadError, ReplicaFleetBase
+from .scheduler import ServeConfig
 
-
-class ReplicaDeadError(RuntimeError):
-    """A replica's worker thread died and the supervisor took it out
-    of service: its pending futures fail with this.  With a WAL
-    attached the ACKNOWLEDGED writes themselves are durable (recovery
-    / promotion replays them) — only the futures fail, honestly."""
+__all__ = ["FleetRouter", "ReplicaDeadError"]
 
 
 def _strip_wal(cfg: ServeConfig, keep: str | None) -> ServeConfig:
@@ -87,7 +84,7 @@ def _strip_wal(cfg: ServeConfig, keep: str | None) -> ServeConfig:
     )
 
 
-class FleetRouter:
+class FleetRouter(ReplicaFleetBase):
     """Front door over N replica ``Server``s sharing one plan store."""
 
     def __init__(self, servers, home: int = 0,
@@ -111,40 +108,18 @@ class FleetRouter:
         # on the 8-virtual-device mesh) — so every replica engine's
         # exec lock is replaced with one shared lock. A real fleet
         # with per-replica devices runs replicas as separate
-        # processes; in-process, serialization is the device truth.
+        # processes (serve/procfleet.py); in-process, serialization
+        # is the device truth.
         self._device_lock = threading.RLock()
         for s in self.replicas:
             s.engine._exec_lock = self._device_lock
-        self._rr = itertools.count()
         self._fan_lock = threading.Lock()  # one fan-out at a time
-        self.submitted: list[int] = [0] * len(self.replicas)
-        self.spillovers = 0
-        self.fanouts = 0
         self._scrape = None  # obs.export.ScrapeServer (serve_metrics)
-        # -- self-healing state (round 16) -----------------------------
         #: Fleet-level fault injection (the ``fleet.fanout`` point).
         self.faults = FaultInjector()
         #: Durability dir (the home's) — promotion / replacement source.
         self.wal_dir = self.replicas[self.home]._ckpt_dir
-        # fan-out generation accounting: versions_behind[i] =
-        # _fan_gen - _replica_gen[i] (0 = replica serves the home's
-        # latest fanned-out version)
-        self._fan_gen = 0
-        self._replica_gen = [0] * len(self.replicas)
-        self._draining: set[int] = set()
-        self._drain_gen: dict[int, int] = {}  # fan gen at drain time
-        # slots whose quarantined server still awaits a replacement:
-        # STICKY until _spawn_replica heals them — _dead() goes False
-        # the moment quarantine closes the scheduler, so without this
-        # a transient rebuild failure would be forgotten forever
-        self._needs_rebuild: set[int] = set()
-        self._sup_lock = threading.RLock()  # serializes heal actions
-        self._sup_thread: threading.Thread | None = None
-        self._sup_stop = threading.Event()
-        self._sup_interval = 0.05
-        self.promotions = 0
-        self.replacements = 0
-        self.read_retries = 0
+        self._init_policy()  # routing/supervision state (policy.py)
         obs.gauge("serve.fleet.replicas", len(self.replicas))
 
     def serve_metrics(self, port: int = 0, host: str = "127.0.0.1"
@@ -308,134 +283,7 @@ class FleetRouter:
                 s.start()
         return router
 
-    # -- read path ---------------------------------------------------------
-
-    def _route_order(self) -> list[int]:
-        """SERVING replica indices, least queue depth first; ties
-        broken by a rotating offset so equal-depth replicas share
-        evenly.  Dead (worker died), closed, and draining replicas are
-        SKIPPED — before round 16 a dead replica still attracted
-        traffic purely by its empty queue depth."""
-        alive = [
-            i for i, s in enumerate(self.replicas)
-            if i not in self._draining and s.is_serving()
-        ]
-        if not alive:
-            # nothing serves: route everywhere so the caller sees the
-            # real rejection instead of an empty-fleet IndexError
-            alive = list(range(len(self.replicas)))
-        depths = {i: self.replicas[i].scheduler.depth() for i in alive}
-        off = next(self._rr) % len(self.replicas)
-        return sorted(
-            alive,
-            key=lambda i: (depths[i], (i - off) % len(self.replicas)),
-        )
-
-    def submit(self, kind: str, root, timeout_s: float | None = None,
-               read_retry: int = 1):
-        """Route one query to the least-loaded serving replica,
-        spilling to the next on backpressure/breaker rejection; raises
-        the LAST rejection only when every replica refused.
-
-        ``read_retry`` (round 16) bounds execution-side retries: a
-        future that fails with a replica-level error (worker death,
-        injected fault, poison-exhausted batch — NOT backpressure,
-        malformed-root, or deadline errors) is re-submitted once per
-        budget unit to the next-best OTHER replica before the caller
-        sees the failure.  Reads only — writes have exactly one home
-        lineage and never retry implicitly."""
-        last_exc: Exception | None = None
-        for i in self._route_order():
-            try:
-                fut = self.replicas[i].submit(
-                    kind, root, timeout_s=timeout_s
-                )
-            except (BackpressureError, RuntimeError) as e:
-                # backpressure/breaker — or a replica quarantined/
-                # closed between _route_order's liveness check and
-                # this submit (its scheduler raises RuntimeError):
-                # spill to the next replica either way, matching the
-                # retry path's exception taxonomy
-                self.spillovers += 1
-                obs.count("serve.fleet.spillover", replica=i)
-                last_exc = e
-                continue
-            self.submitted[i] += 1
-            obs.count("serve.fleet.submitted", replica=i)
-            if read_retry > 0:
-                return self._with_read_retry(
-                    fut, kind, root, timeout_s, i, read_retry
-                )
-            return fut
-        raise last_exc  # every replica rejected
-
-    def _with_read_retry(self, fut, kind, root, timeout_s,
-                         replica: int, budget: int) -> Future:
-        """Wrap a submitted read's future: on an execution-side
-        failure, re-submit to the next-best OTHER serving replica
-        (bounded by ``budget``); the outer future sees the retried
-        outcome.  Admission-level rejections (backpressure/breaker),
-        malformed roots (ValueError) and expired deadlines
-        (TimeoutError) are NOT retried — they would fail identically
-        or lie about the deadline."""
-        outer: Future = Future()
-
-        def _done(f):
-            exc = f.exception()
-            if exc is None:
-                settle(outer, result=f.result())
-                return
-            if budget <= 0 or isinstance(
-                exc, (BackpressureError, ValueError, TimeoutError)
-            ):
-                settle(outer, exc=exc)
-                return
-            for j in self._route_order():
-                if j == replica:
-                    continue
-                try:
-                    f2 = self.replicas[j].submit(
-                        kind, root, timeout_s=timeout_s
-                    )
-                except (BackpressureError, RuntimeError):
-                    continue
-                self.read_retries += 1
-                self.submitted[j] += 1
-                obs.count("serve.fleet.read_retry", replica=j)
-                inner = self._with_read_retry(
-                    f2, kind, root, timeout_s, j, budget - 1
-                )
-                inner.add_done_callback(
-                    lambda g: settle(
-                        outer,
-                        result=(
-                            g.result() if g.exception() is None
-                            else None
-                        ),
-                        exc=g.exception(),
-                    )
-                )
-                return
-            settle(outer, exc=exc)  # nowhere to retry
-
-        fut.add_done_callback(_done)
-        return outer
-
-    def submit_many(self, kind: str, roots,
-                    timeout_s: float | None = None) -> list:
-        """Bulk submit through the router. Unlike a single server's
-        prefix semantics, spillover means a LATER root can still land
-        after one was rejected fleet-wide — so each rejected root fails
-        its OWN future and admission continues."""
-        out = []
-        for r in roots:
-            try:
-                out.append(self.submit(kind, r, timeout_s=timeout_s))
-            except BackpressureError as e:
-                f: Future = Future()
-                f.set_exception(e)
-                out.append(f)
-        return out
+    # -- read path: routing/spillover/read-retry live in policy.py ---------
 
     # -- write path --------------------------------------------------------
 
@@ -447,6 +295,8 @@ class FleetRouter:
         runs the new version — a replica whose rebuild failed mid-fan
         LAGS visibly (``versions_behind``, degraded health, retried on
         the next fan-out) instead of failing the write."""
+        from concurrent.futures import Future
+
         home = self.replicas[self.home]
         inner = home.submit_update(ops)
         if not fan_out:
@@ -539,60 +389,7 @@ class FleetRouter:
                 )
             return n
 
-    def lagging(self) -> list[int]:
-        """Replica indices serving an older version than the home's
-        latest fan-out (failed/skipped rebuilds — retried next
-        fan-out; degraded ``health()`` while non-empty)."""
-        return [
-            i for i in range(len(self.replicas))
-            if i != self.home
-            and self._replica_gen[i] < self._fan_gen
-        ]
-
-    # -- self-healing: supervision, promotion, rolling restart -------------
-
-    def start_supervisor(self, interval_s: float = 0.05
-                         ) -> "FleetRouter":
-        """Start the liveness supervisor thread: every ``interval_s``
-        it runs ``supervise_once()`` — dead-replica detection,
-        replacement rebuilds, home promotion.  Idempotent; stopped by
-        ``close()`` / ``stop_supervisor()``."""
-        with self._sup_lock:
-            if self._sup_thread is None or not self._sup_thread.is_alive():
-                self._sup_stop.clear()
-                self._sup_interval = float(interval_s)
-                self._sup_thread = threading.Thread(
-                    target=self._sup_loop, name="combblas-fleet-sup",
-                    daemon=True,
-                )
-                self._sup_thread.start()
-        return self
-
-    def stop_supervisor(self, timeout: float = 10.0) -> None:
-        t = self._sup_thread
-        if t is None:
-            return
-        self._sup_stop.set()
-        t.join(timeout)
-        if t.is_alive():
-            raise TimeoutError(
-                f"fleet supervisor did not stop within {timeout}s"
-            )
-        self._sup_thread = None
-
-    def _sup_loop(self) -> None:
-        while not self._sup_stop.is_set():
-            try:
-                self.supervise_once()
-            except Exception as e:  # the supervisor must outlive any
-                # one heal attempt: a failed rebuild is retried on the
-                # next tick, visible in the counter — a dead
-                # supervisor would silently stop all self-healing
-                obs.count(
-                    "serve.fleet.supervisor",
-                    action="error", exc_type=type(e).__name__,
-                )
-            self._sup_stop.wait(self._sup_interval)
+    # -- self-healing: thread-fleet liveness + heal verbs ------------------
 
     def _dead(self, i: int) -> bool:
         """Worker-thread death: started once, no longer running, and
@@ -603,63 +400,6 @@ class FleetRouter:
             w is not None and not w.is_alive()
             and not s._stop and not s.scheduler.closed
         )
-
-    def supervise_once(self) -> dict:
-        """One supervision pass (the supervisor thread's body, callable
-        directly for deterministic tests): detect replicas whose
-        worker died, promote a new home first if the HOME died, then
-        rebuild every dead replica off-lock and re-admit it.  Returns
-        ``{"detected": [...], "promoted": new_home | None,
-        "replaced": [...]}``."""
-        with self._sup_lock:
-            dead = [
-                i for i in range(len(self.replicas))
-                if i not in self._draining
-                and (self._dead(i) or i in self._needs_rebuild)
-            ]
-            out = {"detected": dead, "promoted": None, "replaced": []}
-            if not dead:
-                return out
-            for i in dead:
-                if i not in self._needs_rebuild:
-                    obs.count(
-                        "serve.fleet.supervisor", action="detected"
-                    )
-                # sticky until _spawn_replica heals the slot: a
-                # transient rebuild failure below must be RETRIED on
-                # the next tick, not forgotten (quarantine flips
-                # _dead() false)
-                self._needs_rebuild.add(i)
-            if self.home in dead:
-                try:
-                    out["promoted"] = self.promote()
-                except RuntimeError:
-                    # no WAL to promote from (or no surviving
-                    # replica, or a transient recovery failure):
-                    # promote() already quarantined the home — its
-                    # buffered futures failed honestly — and the
-                    # replace loop below still rebuilds the slot
-                    # (from checkpoint+WAL when durable, else from
-                    # the dead engine's retained COO: the engine
-                    # object outlives its worker thread), so the
-                    # write lane comes back instead of staying down
-                    obs.count(
-                        "serve.fleet.supervisor",
-                        action="promotion_failed",
-                    )
-            for i in dead:
-                try:
-                    self._replace_replica(i)
-                except Exception:
-                    # stays in _needs_rebuild: retried next tick
-                    obs.count(
-                        "serve.fleet.supervisor",
-                        action="replace_error",
-                    )
-                    continue
-                out["replaced"].append(i)
-                obs.count("serve.fleet.supervisor", action="replaced")
-            return out
 
     def promote(self, new_home: int | None = None) -> int:
         """Promote a surviving replica to HOME (round 16) — the
@@ -927,10 +667,7 @@ class FleetRouter:
             "replacements": self.replacements,
             "read_retries": self.read_retries,
             "draining": sorted(self._draining),
-            "supervisor_alive": (
-                self._sup_thread is not None
-                and self._sup_thread.is_alive()
-            ),
+            "supervisor_alive": self._supervisor_alive(),
             "wal_dir": self.wal_dir,
             "per_replica": {
                 i: srv.stats() for i, srv in enumerate(self.replicas)
@@ -941,18 +678,12 @@ class FleetRouter:
         per = {i: srv.health() for i, srv in enumerate(self.replicas)}
         statuses = {h["status"] for h in per.values()}
         lagging = self.lagging()
-        if statuses <= {"ok"} and not lagging:
-            status = "ok"
-        elif "ok" in statuses or "degraded" in statuses:
-            status = "degraded"  # something still serves
-        else:
-            status = "down"
         burns = {
             i: h["slo"]["burn"]
             for i, h in per.items() if h.get("slo") is not None
         }
         return {
-            "status": status,
+            "status": self._fold_status(statuses, lagging),
             "replicas": per,
             "home": self.home,
             # round 16: replicas behind the home's latest fan-out
@@ -960,10 +691,7 @@ class FleetRouter:
             # until the next fan-out or the supervisor heals them
             "lagging": lagging,
             "draining": sorted(self._draining),
-            "supervisor_alive": (
-                self._sup_thread is not None
-                and self._sup_thread.is_alive()
-            ),
+            "supervisor_alive": self._supervisor_alive(),
             "durable": self.wal_dir is not None,
             # fleet-wide SLO budget burn (round 15): worst replica —
             # the pageable number when replicas share one SLO

@@ -34,6 +34,7 @@ when telemetry is disabled.
 from __future__ import annotations
 
 import dataclasses
+import fcntl
 import json
 import math
 import os
@@ -271,24 +272,48 @@ class PlanStore:
                 obs.count("tuner.store.evicted", n)
         return n
 
+    def _lock_file(self) -> str:
+        """Sidecar advisory-lock path — the data file itself is
+        ``os.replace``d by compaction, so flocking it would pin the
+        OLD inode while a sibling locks the new one."""
+        return self.file + ".lock"
+
     def _compact(self, removed_lines: int) -> None:
         """Rewrite ``plans.jsonl`` as exactly the surviving entries
         (insertion order preserved), atomically — a crash mid-rewrite
         leaves either the old or the new file, never a torn one.
-        Fleet stores are SHARED: if the file grew since we read it
-        (another process appended a plan), the rewrite is SKIPPED —
-        losing a sibling's fresh measurement to save a few stale lines
-        is the wrong trade, and the next loader compacts instead.  (A
-        write landing inside the final stat->replace window can still
-        be lost — the store self-heals by re-probing; full fencing
-        would need file locks this robustness contract avoids.)"""
+
+        Fleet stores are SHARED (round 17, the multi-process fleet):
+        the rewrite runs under an EXCLUSIVE advisory ``fcntl.flock``
+        on a sidecar lock file — contention (a sibling compacting)
+        SKIPS the compaction outright, and appends take the SHARED
+        lock around their single ``write`` (still concurrent with
+        each other, excluded only for the microseconds of a rewrite),
+        so no append can land inside the stat→replace window and be
+        clobbered (the PR 9 caveat, now closed).  A file that grew
+        between our load and taking the lock is left alone — losing a
+        sibling's fresh measurement to save a few stale lines is the
+        wrong trade; the next loader compacts instead."""
         tmp = self.file + ".tmp"
+        lf = None
         try:
+            os.makedirs(self.path, exist_ok=True)
+            lf = os.open(
+                self._lock_file(), os.O_CREAT | os.O_RDWR, 0o644
+            )
+            try:
+                fcntl.flock(lf, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            except OSError:
+                # a sibling holds the lock (compacting or mid-append):
+                # skip — compaction is an optimization, never worth
+                # waiting on or racing
+                if obs.ENABLED:
+                    obs.count("tuner.store.compact_skipped")
+                return
             if os.path.getsize(self.file) != getattr(
                 self, "_loaded_size", -1
             ):
-                return  # concurrent appender: leave the log alone
-            os.makedirs(self.path, exist_ok=True)
+                return  # sibling appended since we read: leave it
             with open(tmp, "w", encoding="utf-8") as f:
                 for key, rec in self._plans.items():
                     f.write(json.dumps({
@@ -301,6 +326,13 @@ class PlanStore:
             if obs.ENABLED:
                 obs.count("tuner.store.write_errors")
             return
+        finally:
+            if lf is not None:
+                try:
+                    fcntl.flock(lf, fcntl.LOCK_UN)
+                except OSError:
+                    pass
+                os.close(lf)
         self._compacted += removed_lines
         if obs.ENABLED:
             obs.count("tuner.store.compacted", removed_lines)
@@ -309,16 +341,59 @@ class PlanStore:
         line = json.dumps(
             {"v": SCHEMA, "key": key.to_json(), "plan": rec.to_json()}
         ) + "\n"
+        lf = None
         try:
             os.makedirs(self.path, exist_ok=True)
-            # one write call: a torn write truncates the LAST line,
-            # which the loader then skips as invalid
-            with open(self.file, "a", encoding="utf-8") as f:
-                f.write(line)
+            # SHARED flock (concurrent with other appenders — never a
+            # queue between them) around ONE O_APPEND write syscall:
+            # whole lines under concurrency (the kernel's atomic
+            # append seek), and a compaction rewrite (EXCLUSIVE lock)
+            # cannot interleave with a FENCED in-flight append.  The
+            # lock attempt is NON-BLOCKING with a short bounded retry:
+            # appends must never hang on a wedged lock holder (the
+            # serving write path cannot afford an unbounded wait) —
+            # after the retries the append proceeds UNFENCED, which
+            # re-opens only the narrow lost-to-compaction window and
+            # only while a sibling holds the lock for far longer than
+            # a rewrite takes.  A torn write still only truncates the
+            # LAST line, which the loader skips as invalid.
+            lf = os.open(
+                self._lock_file(), os.O_CREAT | os.O_RDWR, 0o644
+            )
+            locked = False
+            for _ in range(10):
+                try:
+                    fcntl.flock(lf, fcntl.LOCK_SH | fcntl.LOCK_NB)
+                    locked = True
+                    break
+                except OSError:
+                    import time
+
+                    time.sleep(0.005)  # a rewrite lasts ~ms
+            if not locked:
+                os.close(lf)
+                lf = None
+                if obs.ENABLED:
+                    obs.count("tuner.store.append_unfenced")
+            fd = os.open(
+                self.file, os.O_APPEND | os.O_CREAT | os.O_WRONLY,
+                0o644,
+            )
+            try:
+                os.write(fd, line.encode("utf-8"))
+            finally:
+                os.close(fd)
         except OSError:
             # read-only replica: the in-memory plan still routes
             if obs.ENABLED:
                 obs.count("tuner.store.write_errors")
+        finally:
+            if lf is not None:
+                try:
+                    fcntl.flock(lf, fcntl.LOCK_UN)
+                except OSError:
+                    pass
+                os.close(lf)
 
     # -- lookup / record ---------------------------------------------------
 

@@ -205,7 +205,11 @@ def list_snapshots(dirpath: str) -> list[str]:
         return []
     return sorted(
         os.path.join(dirpath, nm) for nm in names
+        # a sibling process's in-flight atomic write (``*.npz.tmp``)
+        # is not a snapshot — never list it as a candidate (round 17:
+        # multi-process fleets checkpoint concurrently)
         if nm.startswith("ckpt-") and nm.endswith(".npz")
+        and ".tmp" not in nm
     )
 
 
@@ -214,24 +218,42 @@ def load_latest_version(dirpath: str, grid, *, writable: bool = True):
     path)`` — a corrupt/truncated newest file (the crash-mid-write
     artifact atomic replace makes rare, or disk damage) falls back to
     the previous retained snapshot with a warning naming the bad file.
+
+    Concurrent-sibling tolerance (round 17, the process fleet): a
+    file that VANISHES between listing and open (a sibling's
+    retention pruner unlinked it, or its ``os.replace`` superseded
+    it) is not corruption — it is skipped silently, and if nothing in
+    the stale listing loads the directory is re-listed ONCE (the
+    sibling that pruned our candidate also wrote a newer one).
     Raises ``dynamic.wal.RecoveryError`` when no candidate loads."""
     import warnings
 
-    candidates = list_snapshots(dirpath)
+    candidates = []
     errors = []
-    for path in reversed(candidates):
-        try:
-            return load_version(path, grid, writable=writable), path
-        except SnapshotError as e:
-            errors.append(str(e))
-            from .. import obs
+    for attempt in (0, 1):
+        candidates = list_snapshots(dirpath)
+        vanished = 0
+        for path in reversed(candidates):
+            try:
+                return load_version(path, grid, writable=writable), path
+            except FileNotFoundError:
+                # pruned/replaced under us: never a SnapshotError —
+                # no rejected-counter, no warning, just the next
+                # candidate (and one fresh listing below)
+                vanished += 1
+                continue
+            except SnapshotError as e:
+                errors.append(str(e))
+                from .. import obs
 
-            obs.count("serve.recovery.snapshot_rejected")
-            warnings.warn(
-                f"skipping unloadable snapshot (falling back to the "
-                f"previous retained one): {e}",
-                stacklevel=2,
-            )
+                obs.count("serve.recovery.snapshot_rejected")
+                warnings.warn(
+                    f"skipping unloadable snapshot (falling back to "
+                    f"the previous retained one): {e}",
+                    stacklevel=2,
+                )
+        if vanished == 0:
+            break  # a re-list cannot surface anything new
     from ..dynamic.wal import RecoveryError
 
     raise RecoveryError(
@@ -363,6 +385,12 @@ def load_version(path: str, grid: Grid, *, writable: bool = True):
         return _load_version(path, grid, writable)
     except SnapshotError:
         raise  # already diagnostic (schema / grid mismatch)
+    except FileNotFoundError:
+        # the file vanished between listing and open (a sibling's
+        # pruner or os.replace) — NOT corruption: propagate so
+        # load_latest_version retries over a fresh listing instead
+        # of mis-counting a spurious SnapshotError
+        raise
     except Exception as e:
         raise SnapshotError(
             f"refusing corrupt or truncated GraphVersion snapshot "

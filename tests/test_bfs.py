@@ -113,7 +113,14 @@ def test_bfs_matches_across_grids():
         np.testing.assert_array_equal(lv, levels_by_grid[0])
 
 
-@pytest.mark.parametrize("shape", [(1, 1), (2, 2), (2, 4)])
+@pytest.mark.parametrize("shape", [
+    (1, 1),
+    # (2,2) is slow-lane (round 17, tier-1 budget): the batched
+    # lanes are grid-independent mechanics and (2,4) keeps the
+    # tier-1-mesh representative
+    pytest.param((2, 2), marks=pytest.mark.slow),
+    (2, 4),
+])
 def test_bfs_batch_matches_single(shape):
     """Multi-source batched BFS (one [n, W] frontier matrix) must produce,
     per lane, exactly the trees/levels of the single-root driver."""
@@ -166,7 +173,13 @@ def test_batch_traversed_edges_matches_host():
         assert te[k] == expect
 
 
-@pytest.mark.parametrize("shape", [(1, 1), (2, 2), (2, 4)])
+@pytest.mark.parametrize("shape", [
+    (1, 1),
+    # (2,2) is slow-lane (round 17, tier-1 budget): (1,1) covers
+    # the compact-lane mechanics, (2,4) the tier-1 mesh
+    pytest.param((2, 2), marks=pytest.mark.slow),
+    (2, 4),
+])
 def test_bfs_batch_compact_matches(shape):
     """Level-compressed batched BFS: identical levels to bfs_batch, and a
     valid BFS tree per lane (parents reconstructed post-hoc are any valid
@@ -313,22 +326,10 @@ def test_validate_bfs_device(shape, rng):
     assert v3[1, 0] > 0 or v3[3, 0] > 0
 
 
-@pytest.mark.parametrize(
-    "shape",
-    [
-        (1, 1),
-        # the multi-device shapes re-run the same tier ladder ~70 s each
-        # on the 1-core CPU mesh; grid coverage of bfs_single rides the
-        # (1,1) case + the batch tests above, so they run under -m slow
-        pytest.param((2, 2), marks=pytest.mark.slow),
-        pytest.param((2, 4), marks=pytest.mark.slow),
-    ],
-)
-def test_bfs_single_matches(shape):
-    """Single-root tiered BFS (the spec's sequential kernel 2): identical
-    levels to the reference bfs() and a valid tree, across tier regimes
-    (tiny tiers forcing dense, generous tiers keeping everything sparse,
-    and a mixed ladder)."""
+def _bfs_single_sweep(shape, root_idx, tier_sets):
+    """Shared body of the bfs_single agreement tests: run each root
+    through each tier config and compare levels + tree validity
+    against the reference ``bfs()``."""
     from combblas_tpu.models.bfs import bfs, bfs_single, validate_bfs_tree
     from combblas_tpu.parallel.ellmat import EllParMat, build_csc_companion
     from combblas_tpu.parallel.spmat import SpParMat
@@ -350,22 +351,50 @@ def test_bfs_single_matches(shape):
     deg = np.bincount(rr, minlength=n)
     d = np.zeros((n, n), bool)
     d[rr, cc] = True
-    big = (n, n, n, n, n, n)
-    for s in np.flatnonzero(deg > 0)[[0, 7]]:
+    for s in np.flatnonzero(deg > 0)[list(root_idx)]:
         p0, l0, _ = bfs(A, int(s))
         L0 = l0.to_global()
-        for tiers in (
-            (("td", (1, 0, 0, 0, 0, 0)),),     # forces dense nearly always
-            (("td", big),),                    # everything top-down
-            (("bu", big),),                    # everything bottom-up
-            (("td", (4, 2, 1, 0, 0, 0)), ("bu", (16, 8, 2, 0, 0, 0)),
-             ("td", big)),                     # mixed ladder
-        ):
+        for tiers in tier_sets:
             p1, l1, _ = bfs_single(E, int(s), csc, csr=csr, tiers=tiers)
             np.testing.assert_array_equal(L0, l1.to_global(), err_msg=str(tiers))
             assert not validate_bfs_tree(
                 d, int(s), p1.to_global(), l1.to_global()
             ), tiers
+
+
+_BFS_SINGLE_N = 1 << 8
+_BFS_SINGLE_BIG = (_BFS_SINGLE_N,) * 6
+#: The four tier regimes the sweep covers; each DISTINCT tuple traces
+#: its own one-launch program, so compiles dominate the test's cost.
+_BFS_SINGLE_TIERS = (
+    (("td", (1, 0, 0, 0, 0, 0)),),          # forces dense nearly always
+    (("td", _BFS_SINGLE_BIG),),             # everything top-down
+    (("bu", _BFS_SINGLE_BIG),),             # everything bottom-up
+    (("td", (4, 2, 1, 0, 0, 0)), ("bu", (16, 8, 2, 0, 0, 0)),
+     ("td", _BFS_SINGLE_BIG)),              # mixed ladder
+)
+
+
+def test_bfs_single_matches():
+    """Single-root tiered BFS (the spec's sequential kernel 2), the
+    tier-1 representative (round 17, budget): ONE root through the
+    two information-densest regimes — the forced-dense config and the
+    mixed td/bu/td ladder (which exercises every tier transition plus
+    the dense peak in one program).  The full sweep (both roots, all
+    four regimes, multi-device grids) runs under ``-m slow``."""
+    _bfs_single_sweep(
+        (1, 1), [0], (_BFS_SINGLE_TIERS[0], _BFS_SINGLE_TIERS[3])
+    )
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("shape", [(1, 1), (2, 2), (2, 4)])
+def test_bfs_single_matches_full_sweep(shape):
+    """The exhaustive regime x root x grid sweep (each pure-td and
+    pure-bu ladder compiles its own ~10 s program on the 1-core CPU
+    mesh; the fast representative above keeps the mixed ladder +
+    forced-dense coverage in tier-1)."""
+    _bfs_single_sweep(shape, [0, 7], _BFS_SINGLE_TIERS)
 
 
 def test_single_traversed_edges_matches():

@@ -320,7 +320,12 @@ def test_resplit3d_roundtrip(rng):
     np.testing.assert_allclose(back.to_dense(), d)
 
 
+@pytest.mark.slow
 def test_mcl_3d_matches_2d(rng):
+    # slow-lane (round 17, tier-1 budget): the end-to-end layered
+    # MCL re-pays ~12 s of 3D compiles whose building blocks (3D
+    # column ops, 2D<->3D conversions, spgemm3d agreement) each
+    # keep their own fast tests in this file
     """mcl(layers=2) must produce the same clustering as the 2D path
     (the SpGEMM3DTest equivalence pattern applied to the full pipeline)."""
     from combblas_tpu.models.mcl import mcl

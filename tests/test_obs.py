@@ -802,3 +802,66 @@ def test_round16_durability_counters_gated(rng, tmp_path):
     finally:
         obs.disable()
         obs.reset()
+
+
+def test_round17_procfleet_counters_gated():
+    """ISSUE 15 satellite: the round-17 process-fleet IPC series —
+    per-RPC latency, per-request deadline timeouts, quarantine — are
+    emitted under obs and cost NOTHING when disabled.  Exercised
+    through the parent-side replica client over an in-process stub
+    responder (a socketpair, not a subprocess: the gate measures the
+    ROUTER's bookkeeping, and must stay tier-1 cheap)."""
+    import socket
+    import threading
+    import time as _time
+
+    from combblas_tpu.serve.ipc import Channel, ChannelClosed
+    from combblas_tpu.serve.procfleet import (
+        IpcTimeoutError,
+        ReplicaDeadError,
+        ReplicaProc,
+    )
+
+    def exercise(tag):
+        a, b = socket.socketpair()
+        stop = threading.Event()
+        ch_child = Channel(b)
+
+        def responder():
+            while not stop.is_set():
+                try:
+                    m = ch_child.recv(timeout=0.05)
+                except socket.timeout:
+                    continue
+                except ChannelClosed:
+                    return
+                if m.get("op") == "ping":
+                    ch_child.send({"id": m["id"], "ok": True,
+                                   "result": {"pong": True}})
+                # "hang" never answers: the deadline sweep's case
+
+        threading.Thread(target=responder, daemon=True).start()
+        rp = ReplicaProc(0, None, Channel(a))
+        assert rp.call("ping", timeout_s=10)["pong"] is True
+        f = rp.rpc("hang", timeout_s=0.15)
+        assert isinstance(f.exception(timeout=10), IpcTimeoutError)
+        rp.quarantine(ReplicaDeadError(f"gate teardown {tag}"))
+        stop.set()
+
+    assert not obs.ENABLED
+    exercise("off")
+    assert obs.registry.empty()  # disabled: zero bookkeeping
+
+    obs.enable(install_hooks=False)
+    try:
+        obs.reset()
+        exercise("on")
+        g = obs.registry.get_counter
+        assert obs.registry.get_histogram(
+            "serve.procfleet.rpc_latency_s", op="ping"
+        )["count"] == 1
+        assert g("serve.procfleet.ipc_timeouts", op="hang") == 1
+        assert g("serve.procfleet.quarantined", replica=0) == 1
+    finally:
+        obs.disable()
+        obs.reset()

@@ -1,0 +1,417 @@
+"""Process-isolated serving fleet (round 17, ISSUE 15): subprocess
+replicas with real crash domains behind the shared routing/supervision
+policy — IPC framing, per-request deadlines, heartbeat liveness,
+SIGKILL/SIGSTOP chaos over the WAL/checkpoint substrate.
+
+Tier-1 keeps ONE spawning representative (single replica, 1x1 grid,
+pre-staged checkpoint, deterministic ``supervise_once``) plus
+spawn-free unit tests of the IPC channel, the parent-side replica
+client (stub responder over a socketpair — no subprocess, no jax
+child), and the deterministic process fault plan.  The real-signal
+chaos scenarios (SIGKILL respawn, SIGSTOP heartbeat-timeout
+promotion) are ``slow``; ``BENCH_FLEET=process`` is their measured
+twin.
+"""
+
+import os
+import signal
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from combblas_tpu.dynamic import open_wal, recover_version
+from combblas_tpu.parallel.grid import Grid
+from combblas_tpu.serve import (
+    BackpressureError,
+    GraphEngine,
+    ProcessFaultPlan,
+    ProcessFleet,
+    ServeConfig,
+)
+from combblas_tpu.serve.ipc import Channel, ChannelClosed
+from combblas_tpu.serve.procfleet import (
+    IpcTimeoutError,
+    ReplicaDeadError,
+    ReplicaProc,
+)
+from combblas_tpu.utils import checkpoint
+
+N = 64
+
+
+def _coo(seed, n=N, m=300):
+    r = np.random.default_rng(seed)
+    rows = r.integers(0, n, m)
+    cols = r.integers(0, n, m)
+    return (
+        np.concatenate([rows, cols]), np.concatenate([cols, rows])
+    )
+
+
+def _absent_pairs(rows, cols, k, n=N):
+    present = set(zip(rows.tolist(), cols.tolist()))
+    out = []
+    for i in range(n):
+        for j in range(i + 1, n):
+            if (i, j) not in present and (j, i) not in present:
+                out.append((i, j))
+                if len(out) >= k:
+                    return out
+    return out
+
+
+# --- IPC framing (no processes) ----------------------------------------------
+
+
+def test_ipc_channel_roundtrip_with_ndarrays():
+    a, b = socket.socketpair()
+    ca, cb = Channel(a), Channel(b)
+    msg = {
+        "id": 1, "ok": True,
+        "result": {"levels": np.arange(6, dtype=np.int32).reshape(2, 3),
+                   "n": np.int64(7), "f": np.float32(0.5)},
+    }
+    ca.send(msg)
+    got = cb.recv(timeout=5)  # arrays rebuilt by decode()
+    np.testing.assert_array_equal(
+        got["result"]["levels"], np.arange(6).reshape(2, 3)
+    )
+    assert got["result"]["levels"].dtype == np.int32
+    assert got["result"]["n"] == 7
+    # a closed peer is a clean ChannelClosed, never a desync
+    ca.close()
+    with pytest.raises(ChannelClosed):
+        cb.recv(timeout=5)
+    cb.close()
+
+
+def test_ipc_oversized_frame_refused():
+    from combblas_tpu.serve import ipc
+
+    a, b = socket.socketpair()
+    ca = Channel(a)
+    big = "x" * (ipc.MAX_FRAME + 1)
+    with pytest.raises(ValueError, match="too large"):
+        ca.send({"blob": big})
+    ca.close()
+    b.close()
+
+
+# --- parent-side replica client over a stub responder ------------------------
+
+
+def _stub_replica(script=None, idx=0, **kw):
+    """A ReplicaProc whose 'child' is an in-process responder thread —
+    the parent-side bookkeeping (deadline sweep, heartbeat tracking,
+    error mapping, quarantine) without spawning an interpreter."""
+    a, b = socket.socketpair()
+    stop = threading.Event()
+    ch_child = Channel(b)
+
+    def responder():
+        while not stop.is_set():
+            try:
+                m = ch_child.recv(timeout=0.05)
+            except socket.timeout:
+                continue
+            except ChannelClosed:
+                return
+            op = m.get("op")
+            if op == "ping":
+                ch_child.send({"id": m["id"], "ok": True,
+                               "result": {"pong": True}})
+            elif op == "hang":
+                pass  # never answers: the deadline sweep's case
+            elif op == "badroot":
+                ch_child.send({"id": m["id"], "ok": False,
+                               "etype": "ValueError",
+                               "error": "root out of range"})
+            elif op == "busy":
+                ch_child.send({"id": m["id"], "ok": False,
+                               "etype": "BackpressureError",
+                               "error": "queue full",
+                               "retry_after_s": 0.02})
+            elif op == "hb":
+                ch_child.send({"hb": {"depth": 3, "serving": True,
+                                      "t": time.time()}})
+
+    t = threading.Thread(target=responder, daemon=True)
+    t.start()
+    rp = ReplicaProc(idx, None, Channel(a), **kw)
+    return rp, stop, ch_child
+
+
+def test_replica_client_rpc_deadline_and_error_mapping():
+    rp, stop, _ch = _stub_replica(ipc_timeout_s=30.0)
+    try:
+        assert rp.call("ping")["pong"] is True
+        # per-request deadline: a hung op fails ITS future with the
+        # replica-level (read-retried) error — the router never wedges
+        f = rp.rpc("hang", timeout_s=0.2)
+        with pytest.raises(IpcTimeoutError):
+            f.result(timeout=10)
+        assert rp.ipc_timeouts == 1
+        # child-side taxonomy survives the wire
+        with pytest.raises(ValueError):
+            rp.rpc("badroot", timeout_s=5).result(timeout=10)
+        exc = rp.rpc("busy", timeout_s=5).exception(timeout=10)
+        assert isinstance(exc, BackpressureError)
+        # heartbeats update the hang detector's clock
+        rp.rpc("hb", timeout_s=5)
+        t0 = time.monotonic()
+        while rp.last_hb.get("depth") != 3:
+            assert time.monotonic() - t0 < 5
+            time.sleep(0.005)
+        assert rp.heartbeat_age() < 5
+        assert rp.depth() >= 3  # hb depth counts toward routing load
+    finally:
+        stop.set()
+        rp.quarantine(ReplicaDeadError("teardown"))
+
+
+def test_replica_client_quarantine_fails_pending_honestly():
+    rp, stop, _ch = _stub_replica()
+    try:
+        f = rp.rpc("hang", timeout_s=60)
+        n = rp.quarantine(ReplicaDeadError("replica 0 died"))
+        assert n == 1
+        assert isinstance(f.exception(timeout=5), ReplicaDeadError)
+        assert not rp.is_serving()
+        with pytest.raises(ReplicaDeadError):
+            rp.rpc("ping")
+    finally:
+        stop.set()
+
+
+def test_replica_client_local_backpressure_bound():
+    rp, stop, _ch = _stub_replica(max_inflight=2)
+    try:
+        rp.rpc("hang", timeout_s=60)
+        rp.rpc("hang", timeout_s=60)
+        with pytest.raises(BackpressureError):
+            rp.submit("bfs", 1)
+    finally:
+        stop.set()
+        rp.quarantine(ReplicaDeadError("teardown"))
+
+
+def test_broken_channel_fails_pending_and_marks_dead():
+    rp, stop, ch_child = _stub_replica()
+    try:
+        f = rp.rpc("hang", timeout_s=60)
+        ch_child.close()  # the process died: EOF on the socket
+        assert isinstance(f.exception(timeout=10), ReplicaDeadError)
+        t0 = time.monotonic()
+        while not rp.broken:
+            assert time.monotonic() - t0 < 5
+            time.sleep(0.005)
+        assert not rp.is_serving()
+    finally:
+        stop.set()
+
+
+# --- deterministic process fault plan ----------------------------------------
+
+
+def test_process_fault_plan_is_deterministic():
+    plan = ProcessFaultPlan()
+    plan.sigkill(2, replica="home").sigstop(4, replica=1)
+    fired = []
+    for _ in range(6):
+        fired.extend(plan.step())
+    assert fired == [("SIGKILL", "home"), ("SIGSTOP", 1)]
+    assert plan.stats()["calls"] == 6
+    assert [f[0] for f in plan.stats()["fired"]] == [2, 4]
+    # unarmed plans cost one attribute read and fire nothing
+    assert ProcessFaultPlan().step() == []
+
+
+# --- the tier-1 spawning representative --------------------------------------
+
+
+def test_single_process_replica_end_to_end(tmp_path):
+    """THE fast representative (ISSUE 15 budget satellite): one
+    subprocess replica on a 1x1 grid booted from a pre-staged
+    checkpoint — reads over IPC, zero post-warmup retraces asserted
+    over IPC, a WAL-durable write, heartbeat surfaced in health(),
+    deterministic supervise_once, clean close, and crash recovery
+    from the files agreeing with the served state."""
+    rows, cols = _coo(41)
+    grid = Grid.make(1, 1)
+    eng = GraphEngine.from_coo(grid, rows, cols, N, kinds=("bfs",),
+                               keep_coo=True, headroom=0.5)
+    ckpt = str(tmp_path / "boot.npz")
+    checkpoint.save_version(ckpt, eng.version)
+    wal_dir = str(tmp_path / "wal")
+    fr = ProcessFleet.from_checkpoint(
+        ckpt, (1, 1), replicas=1, kinds=("bfs",),
+        config=ServeConfig(lane_widths=(1, 2), update_flush=1,
+                           update_max_delay_s=0.005),
+        wal_dir=wal_dir, workdir=str(tmp_path / "proc"),
+        hb_interval_s=0.05, hb_timeout_s=5.0,
+    )
+    try:
+        marks = fr.trace_marks()
+        # reads route over IPC and answer exactly like the donor
+        lev = fr.submit("bfs", 3).result(timeout=60)["levels"]
+        ref = eng.execute("bfs", np.asarray([3], np.int32))["levels"]
+        np.testing.assert_array_equal(
+            np.asarray(lev), np.asarray(ref)[:, 0]  # lane 0 = root 3
+        )
+        # zero post-warmup retraces IN THE CHILD, asserted over IPC
+        # (the shared plan store + boot warmup claim)
+        assert fr.retraces_since(marks) == 0
+        # a write is WAL-durable before its future resolves; headroom
+        # keeps the merge incremental so plans survive
+        (a, b), (a2, b2) = _absent_pairs(rows, cols, 2)
+        res = fr.submit_update(
+            [("insert", a, b), ("insert", b, a)]
+        ).result(timeout=60)
+        assert res["ops"] == 2 and res["lagging"] == []
+        lev = fr.submit("bfs", a).result(timeout=60)["levels"]
+        assert np.asarray(lev)[b] == 1
+        # heartbeat liveness is a first-class health fact
+        h = fr.health()
+        assert h["status"] == "ok" and h["durable"]
+        assert h["replicas"][0]["heartbeat_age_s"] < 5.0
+        assert h["replicas"][0]["pid"] == fr.replicas[0].proc.pid
+        # nothing to heal: the deterministic supervision pass is a
+        # no-op on a healthy fleet
+        assert fr.supervise_once() == {
+            "detected": [], "promoted": None, "replaced": [],
+        }
+        # close-race regression (round-17 review): a write racing
+        # close(drain=True) must SETTLE — merged+durable on the home,
+        # fanned or honestly un-fanned — never strand against the
+        # shut-down fan executor
+        late = fr.submit_update([("insert", a2, b2),
+                                 ("insert", b2, a2)])
+    finally:
+        fr.close(drain=True)
+    assert late.result(timeout=60)["ops"] == 2
+    # the subprocess exited cleanly and the durable files recover the
+    # exact served state (acknowledged write included)
+    assert fr.replicas[0].proc.poll() is not None
+    wal = open_wal(wal_dir)
+    v = recover_version(wal_dir, wal, grid, kinds=("bfs",))
+    wal.close()
+    rr, rc, _ = v.E.to_host_coo()
+    assert (a, b) in set(zip(rr.tolist(), rc.tolist()))
+
+
+# --- real-signal chaos (slow; BENCH_FLEET=process is the measured twin) ------
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_sigkill_and_sigstop_chaos_heals(tmp_path):
+    """Real crash domains: SIGKILL a non-home replica (respawn from
+    checkpoint+WAL serves every acknowledged write), then SIGSTOP the
+    home — a HANG, not a death: heartbeat timeout detects it, its
+    in-flight futures fail honestly instead of wedging the router,
+    and promotion at the WAL frontier moves the write lane to a
+    survivor.  The tier-1 representative of the spawn/IPC/supervise
+    path is ``test_single_process_replica_end_to_end``."""
+    rows, cols = _coo(42)
+    fr = ProcessFleet.build(
+        (1, 1), rows, cols, N, replicas=3, kinds=("bfs",),
+        config=ServeConfig(lane_widths=(1, 2), update_flush=1,
+                           update_max_delay_s=0.005),
+        wal_dir=str(tmp_path / "wal"),
+        workdir=str(tmp_path / "proc"),
+        hb_interval_s=0.1, hb_timeout_s=1.5,
+        from_coo_kw={"headroom": 0.5},
+    )
+    try:
+        pairs = _absent_pairs(rows, cols, 2)
+        (a0, b0), (a1, b1) = pairs
+        fr.submit_update(
+            [("insert", a0, b0), ("insert", b0, a0)]
+        ).result(timeout=60)
+
+        # -- SIGKILL a non-home replica: crash detection + respawn
+        victim = (fr.home + 1) % 3
+        os.kill(fr.replicas[victim].proc.pid, signal.SIGKILL)
+        t0 = time.monotonic()
+        while not fr._dead(victim):
+            assert time.monotonic() - t0 < 10
+            time.sleep(0.02)
+        out = fr.supervise_once()
+        assert victim in out["replaced"]
+        lev = fr.replicas[victim].submit(
+            "bfs", a0
+        ).result(timeout=60)["levels"]
+        assert np.asarray(lev)[b0] == 1  # acked write survived SIGKILL
+
+        # -- SIGSTOP the home: hang detection via heartbeat timeout
+        home0 = fr.home
+        os.kill(fr.replicas[home0].proc.pid, signal.SIGSTOP)
+        stuck = fr.replicas[home0].submit("bfs", a0)  # in-flight
+        t0 = time.monotonic()
+        while not fr._dead(home0):
+            assert time.monotonic() - t0 < 15
+            time.sleep(0.02)
+        out = fr.supervise_once()
+        assert out["promoted"] is not None and fr.home != home0
+        # honest failure, not a wedge: the stopped replica's future
+        assert isinstance(stuck.exception(timeout=30),
+                          (ReplicaDeadError, IpcTimeoutError))
+        # routed reads keep serving throughout
+        for _ in range(4):
+            assert fr.submit("bfs", a0).result(timeout=60) is not None
+        # the write lane continues on the promoted lineage, fleet-wide
+        res = fr.submit_update(
+            [("insert", a1, b1), ("insert", b1, a1)]
+        ).result(timeout=60)
+        assert res["fanned_out"] == 2 and res["lagging"] == []
+        for rp in fr.replicas:
+            lev = rp.submit("bfs", a1).result(timeout=60)["levels"]
+            assert np.asarray(lev)[b1] == 1
+        st = fr.stats()
+        assert st["promotions"] == 1 and st["replacements"] == 2
+        assert fr.health()["status"] == "ok"
+    finally:
+        fr.close(drain=False)
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_scripted_fault_plan_kills_through_router(tmp_path):
+    """``ProcessFaultPlan`` fires real signals at scripted routed-
+    submit indices (deterministic chaos, the FaultInjector philosophy
+    at the process level) while the supervisor heals in the
+    background — availability holds and every routed read settles."""
+    rows, cols = _coo(43)
+    fr = ProcessFleet.build(
+        (1, 1), rows, cols, N, replicas=2, kinds=("bfs",),
+        config=ServeConfig(lane_widths=(1, 2)),
+        wal_dir=str(tmp_path / "wal"),
+        workdir=str(tmp_path / "proc"),
+        hb_interval_s=0.1, hb_timeout_s=1.5,
+    )
+    try:
+        fr.start_supervisor(interval_s=0.05)
+        fr.proc_faults.sigkill(5, replica=(fr.home + 1) % 2)
+        ok = bad = 0
+        for i in range(30):
+            try:
+                fr.submit("bfs", int(rows[i % len(rows)])).result(
+                    timeout=60
+                )
+                ok += 1
+            except Exception:
+                bad += 1
+        assert fr.sigkills == 1
+        assert ok / (ok + bad) >= 0.9
+        # wait for the supervisor to heal the kill before closing
+        deadline = time.monotonic() + 30
+        while (
+            fr._needs_rebuild or any(fr._dead(i) for i in range(2))
+        ) and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert fr.replacements >= 1
+    finally:
+        fr.close(drain=False)

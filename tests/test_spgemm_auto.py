@@ -816,7 +816,15 @@ def test_blocked_dispatch_matches_fused(rng):
     assert host_nnz(C) == host_nnz(C_f)
 
 
-def test_spgemm_auto_3d_matches_2d(rng):
+@pytest.mark.parametrize("backend", [
+    "dot",
+    # the scatter backend re-runs the whole 2D->3D->2D route for a
+    # second accumulate kernel (~6 s of compiles); the dot case keeps
+    # the routing/conversion coverage in tier-1 (round 17 budget) and
+    # scatter-vs-dot agreement rides the 2D/3D kernel suites
+    pytest.param("scatter", marks=pytest.mark.slow),
+])
+def test_spgemm_auto_3d_matches_2d(rng, backend):
     """ISSUE 7 satellite: the windowed3d route (2D → layered 3D mesh →
     per-layer windowed SUMMA → fiber reduce → back to 2D) agrees
     BIT-EXACTLY with the 2D spgemm_auto product on the 8-device mesh
@@ -831,13 +839,12 @@ def test_spgemm_auto_3d_matches_2d(rng):
         grid, ra, ca, np.ones(len(ra), np.float32), m, m
     )
     ref = spgemm_auto(PLUS_TIMES, A, A, tier="windowed", block_rows=16)
-    for backend in ("scatter", "dot"):
-        C = spgemm_auto(
-            PLUS_TIMES, A, A, tier="windowed3d", grid3=g3,
-            backend=backend, block_rows=16, block_cols=16,
-        )
-        np.testing.assert_array_equal(dense_of(C), dense_of(ref))
-        assert host_nnz(C) == host_nnz(ref)
+    C = spgemm_auto(
+        PLUS_TIMES, A, A, tier="windowed3d", grid3=g3,
+        backend=backend, block_rows=16, block_cols=16,
+    )
+    np.testing.assert_array_equal(dense_of(C), dense_of(ref))
+    assert host_nnz(C) == host_nnz(ref)
 
 
 def test_router_upgrades_windowed_to_3d(rng, monkeypatch):
