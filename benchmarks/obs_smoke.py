@@ -3,6 +3,9 @@ path on a tiny R-MAT graph and write one schema-versioned JSONL trace.
 
     JAX_PLATFORMS=cpu python benchmarks/obs_smoke.py [out.jsonl]
 
+(`--stitched` runs ``run_stitched`` instead: the smallest
+CROSS-PROCESS stitched-trace entrypoint — round 18.)
+
 The trace contains, end to end (docs/observability.md has the schema):
 
   * per-hop BFS spans with ``frontier`` nnz events
@@ -149,8 +152,63 @@ def run(scale: int = SCALE, edgefactor: int = EDGEFACTOR,
     return obs.dump_jsonl()
 
 
+def run_stitched(scale: int = 6, edgefactor: int = 4,
+                 out_path: str | None = None) -> str:
+    """Smallest STITCHED-trace entrypoint (round 18): one subprocess
+    replica, one sampled BFS request — the dump carries ONE
+    schema-``trace`` record spanning two processes (``route`` ->
+    ``ipc_send`` -> ``ipc_wait`` -> the child's queue/assemble/
+    execute/scatter marks -> ``ipc_recv``) whose stages sum to the
+    request wall, plus the fleet's IPC channel accounting and the
+    ``fleetlog/v1`` supervision timeline in the fleet workdir.
+
+        JAX_PLATFORMS=cpu python benchmarks/obs_smoke.py --stitched
+    """
+    import numpy as np
+
+    from combblas_tpu import obs
+    from combblas_tpu.obs import trace as obs_trace
+    from combblas_tpu.serve import ProcessFleet, ServeConfig
+    from combblas_tpu.utils.rmat import rmat_symmetric_coo_host
+
+    if out_path is None:
+        out_path = os.path.join(
+            tempfile.gettempdir(), "obs_smoke_stitched.jsonl"
+        )
+    obs.enable(jsonl_path=out_path, install_hooks=False)
+    prev_rate = obs_trace.sample_rate()
+    obs_trace.set_sample_rate(1.0)
+    work = tempfile.mkdtemp(prefix="obs_smoke_fleet_")
+    n = 1 << scale
+    rows, cols = rmat_symmetric_coo_host(42, scale, edgefactor)
+    fr = ProcessFleet.build(
+        (1, 1), rows, cols, n, replicas=1, kinds=("bfs",),
+        config=ServeConfig(lane_widths=(1, 2)),
+        wal_dir=os.path.join(work, "wal"),
+        workdir=os.path.join(work, "proc"),
+        hb_interval_s=0.2, hb_timeout_s=10.0,
+    )
+    try:
+        deg = np.bincount(rows, minlength=n)
+        root = int(np.flatnonzero(deg > 0)[0])
+        fr.submit("bfs", root).result(timeout=120)
+        for rec in obs_trace.records():
+            if rec["labels"].get("fleet") == "process":
+                stages = " -> ".join(
+                    s["stage"] for s in rec["stages"]
+                )
+                print(f"stitched [{stages}] wall_s={rec['wall_s']:.4f}")
+        print(f"fleetlog {fr.fleetlog.path}")
+    finally:
+        fr.close(drain=True)
+        obs_trace.set_sample_rate(prev_rate)
+    return obs.dump_jsonl()
+
+
 def main():
-    out = run(out_path=sys.argv[1] if len(sys.argv) > 1 else None)
+    argv = [a for a in sys.argv[1:] if a != "--stitched"]
+    entry = run_stitched if "--stitched" in sys.argv[1:] else run
+    out = entry(out_path=argv[0] if argv else None)
     from combblas_tpu import obs
 
     print(f"wrote {out}")

@@ -150,17 +150,43 @@ def _restores_trace_rate(fn):
     return wrapper
 
 
-def _trace_decomposition(obs_trace) -> dict | None:
+def _trace_decomposition(obs_trace, records=None) -> dict | None:
     """Per-stage mean latency (ms) from the sampled request traces —
     the summary-JSON latency decomposition (None when nothing was
-    sampled)."""
-    summary = obs_trace.stage_summary()
+    sampled).  ``records`` narrows the fold to a subset (the process
+    scenario folds only its STITCHED cross-process traces)."""
+    summary = obs_trace.stage_summary(records)
     if not summary:
         return None
     return {
         stage: round(1e3 * d["mean_s"], 3)
         for stage, d in summary.items()
     }
+
+
+#: Stitched-trace stages owned by the router (its own marks) vs the
+#: wire (send + the residual the child's marks don't cover); every
+#: other stage was measured INSIDE the child and shipped back.
+_ROUTER_STAGES = frozenset(("route", "ipc_recv"))
+_IPC_STAGES = frozenset(("ipc_send", "ipc_wait"))
+
+
+def _stitched_split(decomp: dict | None) -> dict | None:
+    """Fold a stitched-trace decomposition into the router / ipc /
+    child 3-way split — the process fleet's isolation-tax headline."""
+    if not decomp:
+        return None
+    out = {"router_ms": 0.0, "ipc_ms": 0.0, "child_ms": 0.0}
+    for stage, ms in decomp.items():
+        if stage.startswith("_"):  # summary pseudo-keys (_wall)
+            continue
+        if stage in _ROUTER_STAGES:
+            out["router_ms"] += ms
+        elif stage in _IPC_STAGES:
+            out["ipc_ms"] += ms
+        else:
+            out["child_ms"] += ms
+    return {k: round(v, 3) for k, v in out.items()}
 
 
 def _setup(scale, edgefactor, width, nqueries, grid_shape, kinds,
@@ -1143,6 +1169,7 @@ def _read_burst_qps(router, stream, timeout=120.0) -> float:
     return len(futs) / (time.perf_counter() - t0)
 
 
+@_restores_trace_rate
 def run_recovery_process(scale: int = SCALE,
                          edgefactor: int = EDGEFACTOR,
                          kinds=("bfs", "pagerank")) -> dict:
@@ -1167,6 +1194,16 @@ def run_recovery_process(scale: int = SCALE,
     from combblas_tpu.utils import checkpoint
 
     sidecar = obs.enable_sidecar("serve-recovery-process")
+    from combblas_tpu.obs import trace as obs_trace
+
+    if sidecar:
+        # sampled requests stitch router+IPC+child marks into one
+        # trace per request; the summary folds them into the
+        # router/ipc/child latency split (rate restored by
+        # @_restores_trace_rate on every exit path)
+        obs_trace.set_sample_rate(
+            float(os.environ.get("BENCH_TRACE_SAMPLE", "0.25"))
+        )
     nreplicas = max(int(os.environ.get("BENCH_FLEET_REPLICAS", "3")), 2)
     nqueries = int(os.environ.get("BENCH_SERVE_QUERIES", "400"))
     nwrites = int(os.environ.get("BENCH_RECOVERY_WRITES", "24"))
@@ -1333,6 +1370,14 @@ def run_recovery_process(scale: int = SCALE,
         if p not in have or (p[1], p[0]) not in have
     ]
 
+    # latency decomposition from the STITCHED traces only (the
+    # thread-fleet comparator's in-process traces would pollute the
+    # router/ipc/child attribution)
+    decomp = _trace_decomposition(obs_trace, [
+        r for r in obs_trace.records()
+        if r["labels"].get("fleet") == "process"
+    ])
+
     out = {
         "metric": "serve_recovery_process_availability",
         "unit": "fraction_ok",
@@ -1372,6 +1417,8 @@ def run_recovery_process(scale: int = SCALE,
         "final_home": stats["home"],
         "p50_ms": round(1e3 * _percentile(lat, 0.50), 2) if lat else None,
         "p99_ms": round(1e3 * _percentile(lat, 0.99), 2) if lat else None,
+        "latency_decomposition_ms": decomp,
+        "latency_split_ms": _stitched_split(decomp),
         "qps_under_kills": round(nqueries / wall_s, 2),
         # the replica-parallelism headline: N processes (own runtimes)
         # vs N threads behind one shared exec lock, same read burst.

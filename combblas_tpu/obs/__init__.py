@@ -55,6 +55,7 @@ import os
 
 from .metrics import MetricsRegistry
 from .sinks import (
+    FLEETLOG_SCHEMA,
     FLIGHTREC_SCHEMA,
     SCHEMA,
     SCHEMA_VERSION,
@@ -351,7 +352,7 @@ trace = _trace
 
 __all__ = [
     "ENABLED", "DEVICE_SYNC", "SCHEMA", "SCHEMA_VERSION",
-    "FLIGHTREC_SCHEMA",
+    "FLIGHTREC_SCHEMA", "FLEETLOG_SCHEMA",
     "enable", "disable", "enabled", "enable_sidecar", "reset",
     "reset_spans",
     "count", "gauge", "observe", "span", "span_event",

@@ -179,7 +179,14 @@ class ScrapeServer:
                 )
                 try:
                     if path == "/metrics":
-                        body = render().encode()
+                        # an owner with metrics_records() federates its
+                        # own view (the process fleet folds per-replica
+                        # child snapshots in); plain owners scrape the
+                        # process-local registry
+                        fn = getattr(scrape.owner, "metrics_records", None)
+                        body = render(
+                            fn() if callable(fn) else None
+                        ).encode()
                         ctype = "text/plain; version=0.0.4"
                     elif path == "/healthz":
                         body = scrape._json_of("health")

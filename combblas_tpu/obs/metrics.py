@@ -877,6 +877,49 @@ name                                      kind       meaning
                                                      never hang the
                                                      write path)
 ========================================  =========  ==================
+
+Fleet observability plane (round 18, the serve/procfleet.py +
+serve/ipc.py cross-process plane; ``replica=``-labeled child-process
+series additionally arrive in a ``ProcessFleet.serve_metrics()``
+scrape via the heartbeat-piggybacked registry snapshots):
+
+========================================  =========  ==================
+``serve.ipc.bytes_out`` /                 counter    framed bytes sent/
+``serve.ipc.bytes_in``                               received on one
+                                                     IPC channel, wire
+                                                     size incl. the
+                                                     length prefix —
+                                                     the isolation
+                                                     tax's bandwidth
+                                                     half (labels
+                                                     ``peer``)
+``serve.ipc.encode_s`` /                  histogram  frame encode /
+``serve.ipc.decode_s``                               decode seconds —
+                                                     the serialization
+                                                     half of the
+                                                     isolation tax
+                                                     (labels ``peer``)
+``serve.ipc.deadline_missed``             counter    RPCs that expired
+                                                     in the parent-side
+                                                     deadline sweep (a
+                                                     hung replica's
+                                                     per-request
+                                                     failure; labels
+                                                     ``replica``)
+``serve.procfleet.hb_snapshots``          counter    child registry
+                                                     snapshots
+                                                     piggybacked on
+                                                     heartbeats (the
+                                                     federation wire;
+                                                     emitted INSIDE the
+                                                     child process)
+``serve.fleetlog.events``                 counter    supervision
+                                                     timeline events
+                                                     appended to the
+                                                     ``combblas_tpu.
+                                                     fleetlog/v1`` log
+                                                     (labels ``event``)
+========================================  =========  ==================
 """
 
 from __future__ import annotations

@@ -53,9 +53,16 @@ SCHEMA_VERSION = 1
 #: followed by ordinary ``event`` records — parse_jsonl validates both.
 FLIGHTREC_SCHEMA = "combblas_tpu.flightrec/v1"
 
+#: Supervision-timeline schema (round 18, ``obs/fleetlog.py``): the
+#: process fleet's event log is one meta line under THIS schema
+#: followed by ordinary ``event`` records (spawn, heartbeat-miss,
+#: quarantine, respawn, promotion, ...) — parse_jsonl validates all
+#: three schemas with the same code.
+FLEETLOG_SCHEMA = "combblas_tpu.fleetlog/v1"
+
 _KINDS = ("meta", "span", "event", "counter", "gauge", "histogram",
           "trace")
-_META_SCHEMAS = (SCHEMA, FLIGHTREC_SCHEMA)
+_META_SCHEMAS = (SCHEMA, FLIGHTREC_SCHEMA, FLEETLOG_SCHEMA)
 
 #: Quantiles every histogram summary carries (round 15): computed ONCE
 #: here and reused by the Prometheus exporter and the bench sidecars —

@@ -47,6 +47,11 @@ if "--xla_force_host_platform_device_count" not in os.environ.get(
         + " --xla_force_host_platform_device_count=8"
     )
 
+# obs is import-light (no jax at module level) and reads COMBBLAS_OBS
+# — which the parent pinned into our env — at import time, so the
+# child's telemetry armed/unarmed state mirrors the router's.
+from .. import obs  # noqa: E402
+
 
 def _cfg_from_json(d: dict):
     """Rebuild a ServeConfig from the parent's dataclasses.asdict
@@ -62,41 +67,63 @@ def _cfg_from_json(d: dict):
 class ProcWorker:
     """The child-side dispatcher: one Server, one channel."""
 
-    def __init__(self, channel, hb_interval_s: float = 0.25):
+    def __init__(self, channel, hb_interval_s: float = 0.25,
+                 metrics_interval_s: float = 1.0):
         self.ch = channel
         self.srv = None
         self.grid = None
         self.hb_interval_s = hb_interval_s
+        self.metrics_interval_s = metrics_interval_s
+        self._last_snap_t = 0.0
         self._hb_stop = threading.Event()
         self._stop = False
 
     # -- replies -----------------------------------------------------------
 
-    def _reply(self, rid, result=None, exc: Exception | None = None):
+    def _reply(self, rid, result=None, exc: Exception | None = None,
+               trace: dict | None = None):
         from .ipc import ChannelClosed
 
         try:
             if exc is None:
-                self.ch.send({"id": rid, "ok": True, "result": result})
+                msg = {"id": rid, "ok": True, "result": result}
             else:
-                self.ch.send({
+                msg = {
                     "id": rid, "ok": False,
                     "etype": type(exc).__name__,
                     "error": str(exc),
                     "retry_after_s": getattr(exc, "retry_after_s",
                                              None),
-                })
+                }
+            if trace is not None:
+                # completed child-half stage marks, riding the reply's
+                # JSON head home for router-side stitching (round 18)
+                msg["trace"] = trace
+            self.ch.send(msg)
         except ChannelClosed:
             # the parent died: nothing to report to; the main loop's
             # next recv sees the same closure and exits
             pass
 
-    def _reply_from_future(self, rid, fut):
-        fut.add_done_callback(
-            lambda f: self._reply(rid, result=f.result())
-            if f.exception() is None
-            else self._reply(rid, exc=f.exception())
-        )
+    def _reply_from_future(self, rid, fut, trace=None):
+        def _done(f):
+            rec = None
+            if trace is not None:
+                # finish() is idempotent first-wins: the scatter path
+                # also finishes committed traces, but the reply must
+                # ship COMPLETE marks, and settle order (future first,
+                # trace second) means we close the tail ourselves
+                trace.finish(
+                    status="ok" if f.exception() is None else "error",
+                    stage="scatter",
+                )
+                rec = trace.record()
+            if f.exception() is None:
+                self._reply(rid, result=f.result(), trace=rec)
+            else:
+                self._reply(rid, exc=f.exception(), trace=rec)
+
+        fut.add_done_callback(_done)
 
     # -- heartbeat ---------------------------------------------------------
 
@@ -107,23 +134,39 @@ class ProcWorker:
             srv = self.srv
             if srv is None:
                 continue
+            hb = {
+                "t": time.time(),
+                "pid": os.getpid(),
+                "depth": srv.scheduler.depth(),
+                "serving": srv.is_serving(),
+                "worker_errors": srv.worker_errors,
+                "graph_version": srv.engine.version_id,
+                "wal_frontier": (
+                    srv._wal_frontier
+                    if srv._wal is not None else None
+                ),
+                "updates_pending": (
+                    srv._upd_buffer.depth()
+                    if srv._upd_buffer is not None else 0
+                ),
+            }
+            if obs.ENABLED:
+                # metrics federation (round 18): piggyback a compact
+                # registry snapshot — the aggregate() wire shape — on
+                # the liveness channel at most every
+                # metrics_interval_s; the supervisor folds it into the
+                # fleet scrape with a replica= label
+                now = time.monotonic()
+                if now - self._last_snap_t >= self.metrics_interval_s:
+                    self._last_snap_t = now
+                    try:
+                        obs.count("serve.procfleet.hb_snapshots")
+                        hb["metrics"] = obs.metrics_snapshot()
+                    except Exception:
+                        pass  # a broken provider must not stop
+                        # heartbeats — liveness outranks telemetry
             try:
-                self.ch.send({"hb": {
-                    "t": time.time(),
-                    "pid": os.getpid(),
-                    "depth": srv.scheduler.depth(),
-                    "serving": srv.is_serving(),
-                    "worker_errors": srv.worker_errors,
-                    "graph_version": srv.engine.version_id,
-                    "wal_frontier": (
-                        srv._wal_frontier
-                        if srv._wal is not None else None
-                    ),
-                    "updates_pending": (
-                        srv._upd_buffer.depth()
-                        if srv._upd_buffer is not None else 0
-                    ),
-                }})
+                self.ch.send({"hb": hb})
             except ChannelClosed:
                 return
 
@@ -176,6 +219,9 @@ class ProcWorker:
         self.hb_interval_s = float(
             m.get("hb_interval_s", self.hb_interval_s)
         )
+        self.metrics_interval_s = float(
+            m.get("metrics_interval_s", self.metrics_interval_s)
+        )
         # warm BEFORE taking traffic: with the shared plan store
         # (COMBBLAS_PLAN_STORE in the inherited env) populated, the
         # remembered lanes replay — the parent asserts zero
@@ -218,8 +264,12 @@ class ProcWorker:
                 fut = self.srv.submit(
                     m["kind"], m["root"],
                     timeout_s=m.get("timeout_s"),
+                    trace_rid=m.get("trace"),
                 )
-                self._reply_from_future(rid, fut)
+                self._reply_from_future(
+                    rid, fut,
+                    trace=getattr(fut, "_combblas_trace", None),
+                )
             elif op == "submit_update":
                 ops = [tuple(o) for o in m["ops"]]
                 fut = self.srv.submit_update(ops)
@@ -342,7 +392,9 @@ def main(argv=None) -> int:
     sock = socket.socket(fileno=args.fd)
     from .ipc import Channel
 
-    worker = ProcWorker(Channel(sock), hb_interval_s=args.hb_interval_s)
+    worker = ProcWorker(
+        Channel(sock, peer="parent"), hb_interval_s=args.hb_interval_s
+    )
     try:
         worker.run()
     except Exception:

@@ -570,6 +570,7 @@ class FleetRouter(ReplicaFleetBase):
             self._draining.add(i)
             self._drain_gen[i] = self._fan_gen
         obs.count("serve.fleet.drained", replica=i)
+        self._fleet_event("drain", replica=i, home=(i == self.home))
         self.replicas[i].close(drain=True, timeout=timeout)
 
     def restore(self, i: int) -> None:
@@ -596,6 +597,7 @@ class FleetRouter(ReplicaFleetBase):
                 self._drain_gen.pop(i, None)
             self._draining.discard(i)
         obs.count("serve.fleet.restored", replica=i)
+        self._fleet_event("restore", replica=i, home=(i == self.home))
         if (
             self._replica_gen[i] < self._fan_gen
             and self.replicas[self.home].engine.version.host_coo
@@ -619,6 +621,7 @@ class FleetRouter(ReplicaFleetBase):
             self.restore(i)
             n += 1
         obs.count("serve.fleet.rolling_restarts")
+        self._fleet_event("rolling_restart", replicas=n)
         return n
 
     # -- lifecycle / introspection -----------------------------------------

@@ -33,8 +33,11 @@ import json
 import socket
 import struct
 import threading
+import time
 
 import numpy as np
+
+from .. import obs
 
 #: Hard cap on one frame — a corrupt length prefix must not allocate
 #: gigabytes; real messages are query results (KBs).
@@ -122,10 +125,16 @@ class Channel:
     share the child's channel; the router's request path and its
     supervisor share the parent's).  ``recv`` is single-reader by
     design — each side owns exactly one reader thread/loop.
+
+    ``peer`` labels the round-18 channel accounting series
+    (``serve.ipc.bytes_out/bytes_in/encode_s/decode_s``) so the
+    isolation tax is attributable per replica; obs disabled costs one
+    attribute read per frame.
     """
 
-    def __init__(self, sock: socket.socket):
+    def __init__(self, sock: socket.socket, peer: str | None = None):
         self._sock = sock
+        self._lab = {"peer": peer} if peer else {}
         self._wlock = threading.Lock()
         self._closed = False
         # partial-frame accumulator: a recv() that times out MID-FRAME
@@ -136,7 +145,15 @@ class Channel:
         self._rbuf = b""
 
     def send(self, obj: dict) -> None:
-        data = encode(obj)
+        if obs.ENABLED:
+            t0 = time.perf_counter()
+            data = encode(obj)
+            obs.observe(
+                "serve.ipc.encode_s", time.perf_counter() - t0, **self._lab
+            )
+            obs.count("serve.ipc.bytes_out", len(data) + 4, **self._lab)
+        else:
+            data = encode(obj)
         if len(data) > MAX_FRAME:
             raise ValueError(
                 f"ipc frame too large ({len(data)} bytes); ship big "
@@ -165,6 +182,18 @@ class Channel:
                 if len(self._rbuf) >= 4 + n:
                     data = self._rbuf[4:4 + n]
                     self._rbuf = self._rbuf[4 + n:]
+                    if obs.ENABLED:
+                        t0 = time.perf_counter()
+                        msg = decode(data)
+                        obs.observe(
+                            "serve.ipc.decode_s",
+                            time.perf_counter() - t0,
+                            **self._lab,
+                        )
+                        obs.count(
+                            "serve.ipc.bytes_in", len(data) + 4, **self._lab
+                        )
+                        return msg
                     return decode(data)
             try:
                 c = self._sock.recv(1 << 16)

@@ -115,6 +115,21 @@ class ReplicaFleetBase:
     def _replace_ok(self, i: int) -> None:
         """Heal-success hook (backoff reset)."""
 
+    # -- observability hooks (round 18) ------------------------------------
+
+    def _observe_fleet(self) -> None:
+        """Per-supervisor-tick telemetry hook: subclasses gauge their
+        continuously-scrape-visible liveness series here (ProcessFleet:
+        ``serve.procfleet.heartbeat_age_s{replica=}``) so a scrape sees
+        freshness without anyone calling ``health()``.  Default: none —
+        the base class has no liveness signal of its own."""
+
+    def _fleet_event(self, name: str, **fields) -> None:
+        """Supervision-timeline hook: subclasses with an event log
+        (ProcessFleet's fleetlog) append ``name`` + fields; the base
+        class drops events — the policy layer narrates, the front end
+        decides whether anyone is listening."""
+
     # -- read path ---------------------------------------------------------
 
     def _route_order(self) -> list[int]:
@@ -305,6 +320,7 @@ class ReplicaFleetBase:
         dead replica and re-admit it.  Returns ``{"detected": [...],
         "promoted": new_home | None, "replaced": [...]}``."""
         with self._sup_lock:
+            self._observe_fleet()
             dead = [
                 i for i in range(len(self.replicas))
                 if i not in self._draining
@@ -317,6 +333,9 @@ class ReplicaFleetBase:
                 if i not in self._needs_rebuild:
                     obs.count(
                         self._OBS + ".supervisor", action="detected"
+                    )
+                    self._fleet_event(
+                        "replica_dead", replica=i, home=(i == self.home)
                     )
                 # sticky until the heal succeeds: a transient rebuild
                 # failure below must be RETRIED on the next tick, not
@@ -337,6 +356,9 @@ class ReplicaFleetBase:
                         self._OBS + ".supervisor",
                         action="promotion_failed",
                     )
+                    self._fleet_event(
+                        "promotion_failed", replica=self.home
+                    )
             for i in dead:
                 if not self._replace_allowed(i):
                     continue  # backing off: retried on a later tick
@@ -349,6 +371,7 @@ class ReplicaFleetBase:
                         self._OBS + ".supervisor",
                         action="replace_error",
                     )
+                    self._fleet_event("respawn_failed", replica=i)
                     continue
                 self._replace_ok(i)
                 out["replaced"].append(i)

@@ -42,6 +42,7 @@ crash.  ``serve/faults.py``'s ``ProcessFaultPlan`` scripts real
 from __future__ import annotations
 
 import dataclasses
+import itertools
 import os
 import signal
 import socket
@@ -52,11 +53,24 @@ import time
 from concurrent.futures import Future, ThreadPoolExecutor
 
 from .. import obs
+from ..obs.fleetlog import FleetLog
+from ..obs.recorder import FlightRecorder
+from ..tuner import config as tuner_config
 from .batcher import settle
 from .faults import ProcessFaultPlan
 from .ipc import Channel, ChannelClosed
 from .policy import ReplicaDeadError, ReplicaFleetBase
 from .scheduler import BackpressureError, ServeConfig
+
+#: Router-thread handoff for cross-process trace stitching (round 18):
+#: ``ProcessFleet.submit`` parks the stitched trace here, the replica
+#: handle it routes to picks it up and stamps its rid into the IPC
+#: frame.  Thread-local because concurrent submitting threads must not
+#: cross their traces; read-retry resubmits (which run on reader
+#: threads, where this is empty) are deliberately untraced — the
+#: stitched trace covers the FIRST attempt, the retry is visible as
+#: the ``read_retry`` counter.
+_stitch = threading.local()
 
 __all__ = ["ProcessFleet", "ReplicaProc", "IpcTimeoutError",
            "ReplicaDeadError"]
@@ -95,13 +109,14 @@ def _rebuild_exc(msg: dict) -> Exception:
 
 
 class _Rpc:
-    __slots__ = ("future", "deadline", "t0", "op")
+    __slots__ = ("future", "deadline", "t0", "op", "trace")
 
-    def __init__(self, future, deadline, t0, op):
+    def __init__(self, future, deadline, t0, op, trace=None):
         self.future = future
         self.deadline = deadline
         self.t0 = t0
         self.op = op
+        self.trace = trace
 
 
 class ReplicaProc:
@@ -130,6 +145,11 @@ class ReplicaProc:
         self.last_hb: dict = {}
         self.rpcs = 0
         self.ipc_timeouts = 0
+        # federation: the child's last piggybacked registry snapshot
+        # (the aggregate() wire shape), folded into the fleet scrape
+        # with a replica= label by ProcessFleet.metrics_records()
+        self.last_metrics: list | None = None
+        self.last_metrics_t: float | None = None
         self._reader = threading.Thread(
             target=self._read_loop,
             name=f"combblas-proc-rx{idx}", daemon=True,
@@ -139,9 +159,12 @@ class ReplicaProc:
     # -- the RPC surface ---------------------------------------------------
 
     def rpc(self, op: str, payload: dict | None = None,
-            timeout_s: float | None = None) -> Future:
+            timeout_s: float | None = None, trace=None) -> Future:
         """Send one request; the returned future settles from the
-        reader thread (reply, error, deadline, or channel death)."""
+        reader thread (reply, error, deadline, or channel death).
+        ``trace`` is a router-side stitched RequestTrace: its
+        ``route``/``ipc_send`` marks are charged here, and the reader
+        thread stitches the child's stage marks into it on reply."""
         fut: Future = Future()
         deadline = time.monotonic() + (
             timeout_s if timeout_s is not None else self.ipc_timeout_s
@@ -154,9 +177,13 @@ class ReplicaProc:
             rid = self._next_id
             self._next_id += 1
             self._pending[rid] = _Rpc(
-                fut, deadline, time.perf_counter(), op
+                fut, deadline, time.perf_counter(), op, trace
             )
             self.rpcs += 1
+        if trace is not None:
+            # everything since submit-entry (fault step, route order,
+            # admission checks) is routing time
+            trace.mark("route")
         msg = {"id": rid, "op": op}
         if payload:
             msg.update(payload)
@@ -169,6 +196,8 @@ class ReplicaProc:
             raise ReplicaDeadError(
                 f"replica {self.idx} channel broken: {e}"
             ) from e
+        if trace is not None:
+            trace.mark("ipc_send")
         return fut
 
     def call(self, op: str, payload: dict | None = None,
@@ -196,7 +225,18 @@ class ReplicaProc:
         payload = {"kind": kind, "root": int(root)}
         if timeout_s is not None:
             payload["timeout_s"] = float(timeout_s)
-        return self.rpc("submit", payload, timeout_s=ipc_deadline)
+        # stitched-trace handoff (module docstring): stamp the router's
+        # rid + sampling decision into the frame header; cleared only
+        # AFTER a successful send so a spillover to the next replica
+        # keeps tracing the same request
+        tr = getattr(_stitch, "trace", None)
+        if tr is not None:
+            payload["trace"] = tr.rid
+        fut = self.rpc("submit", payload, timeout_s=ipc_deadline,
+                       trace=tr)
+        if tr is not None:
+            _stitch.trace = None
+        return fut
 
     # -- liveness ----------------------------------------------------------
 
@@ -245,7 +285,12 @@ class ReplicaProc:
                 ))
                 return
             if "hb" in m:
-                self.last_hb = m["hb"]
+                hb = m["hb"]
+                snap = hb.pop("metrics", None)
+                if snap is not None:
+                    self.last_metrics = snap
+                    self.last_metrics_t = time.monotonic()
+                self.last_hb = hb
                 self.last_hb_t = time.monotonic()
                 continue
             with self._lock:
@@ -256,11 +301,44 @@ class ReplicaProc:
                 "serve.procfleet.rpc_latency_s",
                 time.perf_counter() - rpc.t0, op=rpc.op,
             )
+            if rpc.trace is not None:
+                # stitch + commit BEFORE the future settles: a caller
+                # woken by result() must find its trace already in the
+                # log (the round-15 attach-before-poppable precedent)
+                self._stitch_reply(rpc.trace, m)
             if m.get("ok"):
                 settle(rpc.future, result=m.get("result"))
             else:
                 settle(rpc.future, exc=_rebuild_exc(m))
             self._sweep_deadlines()
+
+    def _stitch_reply(self, trace, m: dict) -> None:
+        """Fold the child's shipped stage marks into the router-side
+        trace as ONE stitched record: ``route`` + ``ipc_send`` (marked
+        at send), then the window since ``ipc_send`` split into
+        ``ipc_wait`` (router-observed wait not accounted by the child)
+        + the child's own queue_wait/assemble/execute/scatter marks,
+        closed by ``ipc_recv`` — so ``sum(stages) == wall_s`` holds
+        across two processes.  The two clocks never compare absolute
+        values: the child contributes DURATIONS, scaled down if its
+        reported total somehow exceeds the router-observed window
+        (clock skew must not break the telescoping invariant)."""
+        now = time.perf_counter()
+        cw = max(now - trace._last, 0.0)
+        child = m.get("trace")
+        stages = (child or {}).get("stages") or []
+        dt = sum(max(float(s["s"]), 0.0) for s in stages)
+        scale = 1.0 if dt <= cw or dt <= 0.0 else cw / dt
+        trace.stages.append(["ipc_wait", max(cw - dt * scale, 0.0)])
+        for s in stages:
+            trace.stages.append(
+                [str(s["stage"]), max(float(s["s"]), 0.0) * scale]
+            )
+        trace._last = now
+        trace.annotate(replica=self.idx)
+        trace.finish(
+            status="ok" if m.get("ok") else "error", stage="ipc_recv"
+        )
 
     def _sweep_deadlines(self) -> None:
         now = time.monotonic()
@@ -273,6 +351,10 @@ class ReplicaProc:
         for rpc in expired:
             self.ipc_timeouts += 1
             obs.count("serve.procfleet.ipc_timeouts", op=rpc.op)
+            obs.count("serve.ipc.deadline_missed", replica=self.idx)
+            if rpc.trace is not None:
+                rpc.trace.annotate(replica=self.idx)
+                rpc.trace.finish(status="timeout", stage="ipc_wait")
             settle(rpc.future, exc=IpcTimeoutError(
                 f"replica {self.idx} did not answer {rpc.op!r} "
                 f"within its IPC deadline (hung or overloaded)"
@@ -283,6 +365,9 @@ class ReplicaProc:
             pending = list(self._pending.values())
             self._pending.clear()
         for rpc in pending:
+            if rpc.trace is not None:
+                rpc.trace.annotate(replica=self.idx)
+                rpc.trace.finish(status="error", stage="ipc_wait")
             settle(rpc.future, exc=exc)
         return len(pending)
 
@@ -352,7 +437,9 @@ class ProcessFleet(ReplicaFleetBase):
                  boot_timeout_s: float = 300.0,
                  respawn_backoff_s: float = 0.5,
                  respawn_backoff_max_s: float = 30.0,
-                 home: int = 0):
+                 home: int = 0,
+                 metrics_interval_s: float | None = None,
+                 fleetlog: str | None = None):
         self.grid_shape = tuple(grid_shape)
         self.kinds = tuple(kinds) if kinds else None
         self.config = config
@@ -387,6 +474,30 @@ class ProcessFleet(ReplicaFleetBase):
         )
         self._closing = False
         self.replicas: list[ReplicaProc] = []
+        # -- the fleet observability plane (round 18) ----------------------
+        #: heartbeat-snapshot cadence the children piggyback registry
+        #: snapshots at (knob: COMBBLAS_OBS_HB_METRICS_S)
+        self.metrics_interval_s = tuner_config.obs_hb_metrics_interval(
+            metrics_interval_s
+        )
+        #: supervision timeline — constructed EAGERLY (event() from
+        #: supervisor/reader threads must never race a lazy init); the
+        #: file itself appears only on the first event, and events are
+        #: only emitted when obs is enabled (_fleet_event's gate)
+        self.fleetlog = FleetLog(
+            tuner_config.fleetlog_path(fleetlog)
+            or os.path.join(self.workdir, "fleetlog.jsonl"),
+            tenant="procfleet",
+        )
+        #: post-mortem ring, dumped on every quarantine/promotion
+        self.recorder = FlightRecorder(
+            out_dir=os.path.join(self.workdir, "flightrec"),
+            tenant="procfleet",
+        )
+        #: stitched-trace rid source: crosses the IPC boundary in the
+        #: frame header, so child and router halves correlate
+        self._trace_rid = itertools.count(1)
+        self._scrape = None  # serve_metrics() parity with Server
 
     # -- construction ------------------------------------------------------
 
@@ -496,6 +607,10 @@ class ProcessFleet(ReplicaFleetBase):
             f"--xla_force_host_platform_device_count={self.devices}"
         )
         env["COMBBLAS_WAL"] = "0"
+        # the child's telemetry arms with the ROUTER's current state,
+        # not whatever COMBBLAS_OBS the operator's shell had: a fleet
+        # whose parent enabled obs at runtime still federates
+        env["COMBBLAS_OBS"] = "1" if obs.ENABLED else "0"
         # the child must import THIS package wherever the parent found
         # it — a parent that path-hacked sys.path (or runs from another
         # cwd) would otherwise spawn children that die on import
@@ -533,8 +648,10 @@ class ProcessFleet(ReplicaFleetBase):
         finally:
             log.close()
             child_sock.close()
+        self._fleet_event("spawn", replica=i, pid=proc.pid)
         return ReplicaProc(
-            i, proc, Channel(parent_sock), tenant=f"proc{i}",
+            i, proc, Channel(parent_sock, peer=f"replica{i}"),
+            tenant=f"proc{i}",
             max_inflight=self.config.max_queue,
             ipc_timeout_s=self.ipc_timeout_s,
         )
@@ -550,6 +667,7 @@ class ProcessFleet(ReplicaFleetBase):
             "recover": recover,
             "tenant": f"proc{i}",
             "hb_interval_s": self.hb_interval_s,
+            "metrics_interval_s": self.metrics_interval_s,
         }
 
     @staticmethod
@@ -582,9 +700,30 @@ class ProcessFleet(ReplicaFleetBase):
                read_retry: int = 1):
         for signame, rep in self.proc_faults.step():
             self._apply_fault(signame, rep)
-        return super().submit(
-            kind, root, timeout_s=timeout_s, read_retry=read_retry
-        )
+        # cross-process trace stitching: one deterministic sampling
+        # decision at the FRONT DOOR (obs.request_trace gates on
+        # ENABLED + sample rate), handed to the routed replica via
+        # thread-local; the child traces unconditionally under this
+        # rid, so both halves of the stitched record correlate
+        tr = obs.request_trace(next(self._trace_rid), kind=kind)
+        if tr is None:
+            return super().submit(
+                kind, root, timeout_s=timeout_s, read_retry=read_retry
+            )
+        tr.annotate(fleet="process")
+        _stitch.trace = tr
+        try:
+            return super().submit(
+                kind, root, timeout_s=timeout_s, read_retry=read_retry
+            )
+        except Exception:
+            if getattr(_stitch, "trace", None) is not None:
+                # every replica refused: the request never left the
+                # router — the trace is pure routing time
+                tr.finish(status="rejected", stage="route")
+            raise
+        finally:
+            _stitch.trace = None
 
     def _apply_fault(self, signame: str, rep) -> None:
         i = self.home if rep == "home" else int(rep)
@@ -602,9 +741,11 @@ class ProcessFleet(ReplicaFleetBase):
         if sig == signal.SIGKILL:
             self.sigkills += 1
             obs.count("serve.procfleet.sigkills", replica=i)
+            self._fleet_event("sigkill", replica=i)
         elif sig == signal.SIGSTOP:
             self.sigstops += 1
             obs.count("serve.procfleet.sigstops", replica=i)
+            self._fleet_event("sigstop", replica=i)
 
     # -- write path --------------------------------------------------------
 
@@ -685,14 +826,22 @@ class ProcessFleet(ReplicaFleetBase):
                     continue
                 if i in self._draining or not rp.is_serving():
                     continue
+                prev = self._replica_gen[i]
                 try:
                     rp.call("swap_from_checkpoint", {"path": path},
                             timeout_s=self.ipc_timeout_s)
                     self._replica_gen[i] = gen
                     n += 1
+                    if prev < gen - 1:
+                        # a replica that had fallen MORE than one
+                        # generation behind just caught up
+                        self._fleet_event(
+                            "fanout_heal", replica=i, gen=gen, was=prev
+                        )
                 except Exception:
                     obs.count("serve.procfleet.fanout_failed",
                               replica=i)
+                    self._fleet_event("fanout_lag", replica=i, gen=gen)
             self.fanouts += 1
             obs.count("serve.procfleet.fanout")
             obs.observe("serve.procfleet.fanout_s",
@@ -750,6 +899,79 @@ class ProcessFleet(ReplicaFleetBase):
         self._respawn_backoff.pop(i, None)
         self._respawn_next.pop(i, None)
 
+    # -- the fleet observability plane (round 18) --------------------------
+
+    def _observe_fleet(self) -> None:
+        """Supervisor-tick gauges: heartbeat age per replica is the
+        hang detector's number, and a scrape must see it WITHOUT
+        anyone calling ``health()`` (the autoscaler's sensors read
+        /metrics, not the stats RPC)."""
+        if not obs.ENABLED:
+            return
+        obs.gauge("serve.procfleet.replicas", len(self.replicas))
+        for i, rp in enumerate(self.replicas):
+            obs.gauge("serve.procfleet.heartbeat_age_s",
+                      rp.heartbeat_age(), replica=i)
+
+    def _fleet_event(self, name: str, **fields) -> None:
+        """Append one supervision event to the fleetlog + the flight
+        recorder ring; quarantine/promotion additionally dump the ring
+        so the post-mortem snapshot sits next to the timeline entry.
+        Gated on obs.ENABLED (the zero-cost contract: disabled obs
+        leaves no fleetlog file and no recorder traffic)."""
+        if not obs.ENABLED:
+            return
+        if name == "replica_dead":
+            i = fields.get("replica")
+            rp = self.replicas[i] if i is not None else None
+            if rp is not None:
+                # enrich with the CAUSE the supervisor saw, so the
+                # timeline distinguishes a SIGKILL'd corpse from a
+                # SIGSTOP'd zombie post-mortem
+                code = rp.proc.poll() if rp.proc is not None else None
+                if code is not None:
+                    fields["cause"] = "exited"
+                    fields["exit_code"] = code
+                elif rp.broken:
+                    fields["cause"] = "channel_broken"
+                elif rp.quarantined:
+                    fields["cause"] = "rebuild_pending"
+                else:
+                    fields["cause"] = "heartbeat_miss"
+                    fields["heartbeat_age_s"] = round(
+                        rp.heartbeat_age(), 4
+                    )
+        self.fleetlog.event(name, **fields)
+        self.recorder.record(f"fleet.{name}", **fields)
+        if name in ("quarantine", "promotion"):
+            self.recorder.dump(reason=name, force=True)
+
+    def metrics_records(self) -> list[dict]:
+        """The federated fleet registry view the ``/metrics`` scrape
+        renders: the router's own snapshot plus every replica's last
+        heartbeat-piggybacked child snapshot, relabeled ``replica=i``
+        — one scrape sees the whole fleet."""
+        recs = list(obs.metrics_snapshot())
+        for i, rp in enumerate(self.replicas):
+            for r in rp.last_metrics or ():
+                r2 = dict(r)
+                labels = dict(r2.get("labels") or {})
+                labels["replica"] = i
+                r2["labels"] = labels
+                recs.append(r2)
+        return recs
+
+    def serve_metrics(self, port: int = 0,
+                      host: str = "127.0.0.1") -> int:
+        """Start the fleet-wide Prometheus scrape surface
+        (``/metrics`` + ``/healthz`` + ``/statz``) — the one scrape
+        covering router AND child-process series (via
+        ``metrics_records``).  ``port=0`` binds an ephemeral port; the
+        bound port is returned.  Stopped by ``close()``."""
+        from ..obs import export
+
+        return export.attach_scrape(self, port=port, host=host)
+
     def promote(self, new_home: int | None = None) -> int:
         """Dead-home failover over IPC: quarantine the dead home
         (in-flight futures fail honestly; acknowledged writes are in
@@ -764,6 +986,9 @@ class ProcessFleet(ReplicaFleetBase):
                 "frontier (acknowledged writes are durable and "
                 "replayed there)"
             ))
+            self._fleet_event(
+                "quarantine", replica=old, reason="dead_home"
+            )
             if new_home is None:
                 cands = [
                     i for i in self._route_order()
@@ -793,6 +1018,10 @@ class ProcessFleet(ReplicaFleetBase):
                     "single WAL ownership"
                 ))
                 self._needs_rebuild.add(new_home)
+                self._fleet_event(
+                    "quarantine", replica=new_home,
+                    reason="promote_unknown",
+                )
                 raise RuntimeError(
                     f"promotion of replica {new_home} failed: {e}"
                 ) from e
@@ -800,6 +1029,9 @@ class ProcessFleet(ReplicaFleetBase):
             self._replica_gen[new_home] = self._fan_gen
             self.promotions += 1
             obs.count("serve.procfleet.promotions")
+            self._fleet_event(
+                "promotion", old_home=old, new_home=new_home
+            )
             # surviving replicas may be missing acknowledged writes
             # the dead home never fanned out: propagate the recovered
             # frontier now (best-effort; failures lag visibly)
@@ -821,12 +1053,18 @@ class ProcessFleet(ReplicaFleetBase):
                 f"replica {i} process died; the fleet supervisor is "
                 "respawning a replacement"
             ))
+            self._fleet_event("quarantine", replica=i, reason="respawn")
         rp = self._spawn(i, recover=True, home=(i == self.home))
         self.replicas[i] = rp
         self._replica_gen[i] = self._fan_gen
         self._needs_rebuild.discard(i)
         self.replacements += 1
         obs.count("serve.procfleet.respawns", replica=i)
+        self._fleet_event(
+            "respawn", replica=i,
+            pid=(rp.proc.pid if rp.proc is not None else None),
+            home=(i == self.home),
+        )
 
     # -- lifecycle / introspection -----------------------------------------
 
@@ -863,6 +1101,10 @@ class ProcessFleet(ReplicaFleetBase):
         # home's close-drain settles un-fanned instead of racing a
         # shut-down executor (its future must never strand)
         self._closing = True
+        if self._scrape is not None:
+            from ..obs import export
+
+            export.detach_scrape(self)
         self.stop_supervisor(timeout)
         self._fan_pool.shutdown(wait=True)
         order = [
@@ -894,6 +1136,8 @@ class ProcessFleet(ReplicaFleetBase):
             "draining": sorted(self._draining),
             "supervisor_alive": self._supervisor_alive(),
             "wal_dir": self.wal_dir,
+            "fleetlog": self.fleetlog.describe(),
+            "flightrec": self.recorder.describe(),
             "per_replica": {
                 i: {
                     "pid": (rp.proc.pid if rp.proc is not None

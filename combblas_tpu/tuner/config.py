@@ -106,6 +106,17 @@ ENV_CHECKPOINT_RETAIN = "COMBBLAS_CHECKPOINT_RETAIN"
 #: Valid WAL fsync policies (vetted at the knob, the MERGE precedent).
 WAL_FSYNC_POLICIES = ("always", "off")
 
+#: Round-18 knobs: the fleet observability plane (docs/observability.md
+#: "Process-fleet observability").  ``COMBBLAS_FLEETLOG`` overrides the
+#: supervision-timeline JSONL path the process fleet appends to
+#: (default: ``fleetlog.jsonl`` under the fleet's workdir; unset/``0``/
+#: ``off`` fall through to that default).  ``COMBBLAS_OBS_HB_METRICS_S``
+#: is the minimum seconds between child registry snapshots piggybacked
+#: on replica heartbeats (metrics federation — the fleet-scrape wire
+#: cadence; unset/``0`` = default).
+ENV_FLEETLOG = "COMBBLAS_FLEETLOG"
+ENV_OBS_HB_METRICS_S = "COMBBLAS_OBS_HB_METRICS_S"
+
 #: Round-13 knob: the SpGEMM combine-merge tier (sort | runs | hash) —
 #: how partial-product pieces (3D fiber pieces, 2D ESC stage chunks)
 #: fold into one compacted tile.  Resolution: arg > plan-store record
@@ -145,6 +156,10 @@ DEFAULT_FLEET_REPLICAS = 2
 DEFAULT_WAL_FSYNC = "always"
 DEFAULT_CHECKPOINT_EVERY = 8
 DEFAULT_CHECKPOINT_RETAIN = 2
+#: Federation default (round 18): snapshot the child registry onto the
+#: heartbeat at most once a second — fresh enough for scrape cadences,
+#: cheap enough to vanish in the heartbeat noise.
+DEFAULT_OBS_HB_METRICS_S = 1.0
 
 
 def _str_env(name: str) -> str | None:
@@ -348,6 +363,32 @@ def wal_fsync(given: str | None = None) -> str:
             f"{'|'.join(WAL_FSYNC_POLICIES)}; got {v!r}"
         )
     return v
+
+
+def fleetlog_path(given: str | None = None) -> str | None:
+    """Supervision-timeline JSONL path override, or ``None`` to use the
+    fleet's own default (``fleetlog.jsonl`` under its workdir):
+    explicit argument > ``COMBBLAS_FLEETLOG`` > fleet default.
+    ``0``/``off``/``none``/empty fall through to the default — the
+    wal_dir convention."""
+    v = os.environ.get(ENV_FLEETLOG) if given is None else given
+    if v is None or v.strip().lower() in ("", "0", "off", "none"):
+        return None
+    return os.path.abspath(v)
+
+
+def obs_hb_metrics_interval(given: float | None = None) -> float:
+    """Minimum seconds between child registry snapshots piggybacked on
+    replica heartbeats (metrics federation): explicit argument >
+    ``COMBBLAS_OBS_HB_METRICS_S`` > 1.0.  Clamped >= 0.05 so a typo
+    cannot turn every heartbeat into a full registry serialization."""
+    if given is None:
+        v = os.environ.get(ENV_OBS_HB_METRICS_S)
+        given = float(v) if v else 0.0
+    given = float(given)
+    if given <= 0.0:
+        return DEFAULT_OBS_HB_METRICS_S
+    return max(given, 0.05)
 
 
 def checkpoint_every(given: int | None = None) -> int:

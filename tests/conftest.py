@@ -50,6 +50,16 @@ os.environ["COMBBLAS_WAL_FSYNC"] = ""
 os.environ["COMBBLAS_CHECKPOINT_EVERY"] = "0"
 os.environ["COMBBLAS_CHECKPOINT_RETAIN"] = "0"
 
+# Hermetic fleet-observability knobs (round 18): an ambient
+# COMBBLAS_FLEETLOG would redirect every test ProcessFleet's
+# supervision timeline to an operator path (and cross-test appends
+# would interleave), an ambient COMBBLAS_OBS_HB_METRICS_S would change
+# the heartbeat-snapshot cadence the federation tests time against —
+# pin the defaults ("0" = default per the tuner/config convention);
+# tests that exercise the knobs pass explicit arguments instead.
+os.environ["COMBBLAS_FLEETLOG"] = "0"
+os.environ["COMBBLAS_OBS_HB_METRICS_S"] = "0"
+
 # Hermetic trace sampling (round 15): an ambient
 # COMBBLAS_OBS_TRACE_SAMPLE would make every obs-enabled serve test
 # also record per-request traces (and their ``serve.trace.sampled``

@@ -122,6 +122,36 @@ def test_durability_knobs_centralized(monkeypatch, tmp_path):
         config.wal_fsync("sometimes")
 
 
+def test_fleet_obs_knobs_centralized(monkeypatch, tmp_path):
+    """The round-18 fleet-observability knobs parse through
+    tuner/config with the shared conventions: unset/"0"/"off" disable
+    the fleetlog path, explicit argument beats the env, and the
+    heartbeat-snapshot cadence clamps sane."""
+    from combblas_tpu.tuner import config
+
+    for name in (config.ENV_FLEETLOG, config.ENV_OBS_HB_METRICS_S):
+        assert name.startswith("COMBBLAS_")
+    # conftest pins these to "0" => defaults: no operator fleetlog
+    # redirect, default heartbeat-snapshot cadence
+    assert config.fleetlog_path() is None
+    assert (
+        config.obs_hb_metrics_interval() == config.DEFAULT_OBS_HB_METRICS_S
+    )
+    log = tmp_path / "fleet.jsonl"
+    monkeypatch.setenv(config.ENV_FLEETLOG, str(log))
+    monkeypatch.setenv(config.ENV_OBS_HB_METRICS_S, "2.5")
+    assert config.fleetlog_path() == str(log)
+    assert config.obs_hb_metrics_interval() == 2.5
+    # argument > env; "off"/"0" disable explicitly; cadence clamps
+    assert config.fleetlog_path("off") is None
+    assert config.fleetlog_path("0") is None
+    assert config.obs_hb_metrics_interval(0.001) == 0.05
+    assert (
+        config.obs_hb_metrics_interval(0)
+        == config.DEFAULT_OBS_HB_METRICS_S
+    )
+
+
 def test_pool_fleet_knobs_centralized(monkeypatch):
     """The round-14 pool/fleet knobs parse through tuner/config with
     the shared conventions (unset/empty/"0" = default; explicit

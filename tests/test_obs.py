@@ -865,3 +865,125 @@ def test_round17_procfleet_counters_gated():
     finally:
         obs.disable()
         obs.reset()
+
+
+def test_round18_fleet_obs_gated(tmp_path):
+    """ISSUE 16: the round-18 fleet-observability plane — IPC channel
+    accounting, per-replica deadline misses, the supervision timeline
+    — is emitted under obs and costs NOTHING when disabled: no
+    registry series, no fleetlog file, no flight-recorder traffic.
+    Same stub-responder topology as the round-17 gate (the gate
+    measures the router's bookkeeping, not subprocess boot)."""
+    import socket
+    import threading
+    import types
+
+    from combblas_tpu.obs.fleetlog import FleetLog
+    from combblas_tpu.obs.recorder import FlightRecorder
+    from combblas_tpu.serve.ipc import Channel, ChannelClosed
+    from combblas_tpu.serve.procfleet import (
+        IpcTimeoutError,
+        ProcessFleet,
+        ReplicaDeadError,
+        ReplicaProc,
+    )
+
+    def exercise(tag):
+        a, b = socket.socketpair()
+        stop = threading.Event()
+        ch_child = Channel(b)
+
+        def responder():
+            while not stop.is_set():
+                try:
+                    m = ch_child.recv(timeout=0.05)
+                except socket.timeout:
+                    continue
+                except ChannelClosed:
+                    return
+                if m.get("op") == "ping":
+                    ch_child.send({"id": m["id"], "ok": True,
+                                   "result": {"pong": True}})
+                # "hang" never answers: the deadline sweep's case
+
+        threading.Thread(target=responder, daemon=True).start()
+        rp = ReplicaProc(0, None, Channel(a, peer="replica0"))
+        assert rp.call("ping", timeout_s=10)["pong"] is True
+        f = rp.rpc("hang", timeout_s=0.15)
+        assert isinstance(f.exception(timeout=10), IpcTimeoutError)
+        # the supervisor's event hook over a stub fleet: the gate must
+        # keep the fleetlog file AND the recorder ring untouched
+        stub = types.SimpleNamespace(
+            replicas=[rp],
+            fleetlog=FleetLog(str(tmp_path / f"fleet-{tag}.jsonl")),
+            recorder=FlightRecorder(
+                out_dir=str(tmp_path / f"rec-{tag}")),
+        )
+        ProcessFleet._fleet_event(
+            stub, "quarantine", replica=0, reason="gate"
+        )
+        rp.quarantine(ReplicaDeadError(f"gate teardown {tag}"))
+        stop.set()
+        return stub
+
+    assert not obs.ENABLED
+    stub = exercise("off")
+    assert obs.registry.empty()  # disabled: zero bookkeeping
+    assert not os.path.exists(stub.fleetlog.path)  # no timeline file
+    assert stub.recorder.recorded == 0  # no recorder traffic
+
+    obs.enable(install_hooks=False)
+    try:
+        obs.reset()
+        stub = exercise("on")
+        g = obs.registry.get_counter
+        # channel accounting: both directions, framed byte counts
+        assert g("serve.ipc.bytes_out", peer="replica0") > 0
+        assert g("serve.ipc.bytes_in", peer="replica0") > 0
+        assert obs.registry.get_histogram(
+            "serve.ipc.encode_s", peer="replica0"
+        )["count"] >= 2  # ping + hang
+        assert obs.registry.get_histogram(
+            "serve.ipc.decode_s", peer="replica0"
+        )["count"] >= 1  # pong
+        assert g("serve.ipc.deadline_missed", replica=0) == 1
+        # supervision timeline: ring + file + counter + dump
+        assert g("serve.fleetlog.events", event="quarantine") == 1
+        (ev,) = stub.fleetlog.snapshot()
+        assert ev["name"] == "fleet.quarantine"
+        assert ev["reason"] == "gate"
+        assert os.path.exists(stub.fleetlog.path)
+        assert stub.recorder.dumps == 1  # quarantine dumps the ring
+    finally:
+        obs.disable()
+        obs.reset()
+
+
+def test_fleetlog_jsonl_roundtrip(tmp_path):
+    """ISSUE 16 satellite: the supervision timeline is an ordinary
+    ``combblas_tpu.fleetlog/v1`` JSONL file — every line passes
+    ``validate_record`` via ``parse_jsonl``, reserved envelope fields
+    are remapped (never clobbered), and both the ring and the file are
+    bounded."""
+    from combblas_tpu.obs.fleetlog import FleetLog
+
+    path = str(tmp_path / "fl" / "fleetlog.jsonl")
+    fl = FleetLog(path, capacity=4, max_file_events=5, tenant="t0")
+    assert not os.path.exists(path)  # lazy: idle fleet leaves no file
+    for i in range(7):
+        fl.event("spawn", replica=i, kind="oops", ts="clash")
+    recs = obs.parse_jsonl(path)  # validate=True: schema-checked
+    assert recs[0]["kind"] == "meta"
+    assert recs[0]["schema"] == obs.FLEETLOG_SCHEMA
+    events = [r for r in recs if r["kind"] == "event"]
+    assert len(events) == 5  # file capped at max_file_events
+    assert events[0]["name"] == "fleet.spawn"
+    assert events[0]["tenant"] == "t0"
+    # reserved names remapped, discriminators intact
+    assert events[0]["f_kind"] == "oops"
+    assert events[0]["f_ts"] == "clash"
+    # ring keeps rotating past the file cap, oldest first
+    assert [e["replica"] for e in fl.snapshot()] == [3, 4, 5, 6]
+    d = fl.describe()
+    assert d["recorded"] == 7 and d["file_events"] == 5
+    assert d["truncated"] and d["write_errors"] == 0

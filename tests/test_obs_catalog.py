@@ -68,5 +68,9 @@ def test_known_series_are_swept():
         "serve.flightrec.dumps",     # round 15
         "serve.slo.budget_burn",     # round 15
         "serve.pool.admits",
+        # round 18: emitted by the CHILD process (_procworker.py) —
+        # the sweep must cover subprocess-side series too
+        "serve.procfleet.hb_snapshots",
+        "serve.ipc.bytes_out",
     ):
         assert expected in names, expected

@@ -456,6 +456,61 @@ def test_scrape_endpoint_live(engine, tmp_path):
     assert srv._scrape is None
 
 
+def test_procfleet_metrics_federation_parity():
+    """ISSUE 16: one ``ProcessFleet`` ``/metrics`` scrape federates
+    the router's registry with every replica's heartbeat-piggybacked
+    child snapshot, relabeled ``replica=i``.  Parity-tested through
+    the rendered exposition over stub replicas: the child snapshot is
+    a GENUINE ``metrics_snapshot()`` wire shape (what ``_hb_loop``
+    piggybacks), the subprocess itself is not needed to test the
+    fold."""
+    import types
+
+    from combblas_tpu.serve.procfleet import ProcessFleet
+
+    obs.enable(install_hooks=False)
+    # forge the child's snapshot by actually populating a registry
+    obs.count("serve.requests", 3, kind="bfs")
+    for v in (0.01, 0.02):
+        obs.observe("serve.e2e_s", v, kind="bfs")
+    child_snap = obs.metrics_snapshot()
+    obs.reset()
+    obs.count("serve.requests", 2, kind="pr")  # router-side series
+    stub = types.SimpleNamespace(replicas=[
+        types.SimpleNamespace(last_metrics=child_snap,
+                              last_metrics_t=1.0),
+        types.SimpleNamespace(last_metrics=None,  # no heartbeat yet
+                              last_metrics_t=0.0),
+    ])
+    # the fleet's REAL fold, bound to the stub — the scrape handler
+    # discovers it by name on the owner
+    stub.metrics_records = ProcessFleet.metrics_records.__get__(stub)
+    recs = stub.metrics_records()
+    # every child record is relabeled; the router's stay unlabeled
+    assert {r["labels"].get("replica")
+            for r in recs} == {None, 0}
+    port = obs_export.attach_scrape(stub)
+    assert port == obs_export.attach_scrape(stub)  # idempotent
+    text = urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics", timeout=10
+    ).read().decode()
+    parsed = obs_export.parse_exposition(text)
+    # parity: the served text agrees with a fresh federated render
+    assert parsed == obs_export.parse_exposition(
+        obs_export.render(stub.metrics_records())
+    )
+    child_lab = obs_export._labels({"kind": "bfs", "replica": 0})
+    assert parsed[("combblas_serve_requests", child_lab)] == 3
+    assert parsed[
+        ("combblas_serve_e2e_s_count", child_lab)
+    ] == 2  # histograms federate with their quantile summaries
+    assert parsed[
+        ("combblas_serve_requests", obs_export._labels({"kind": "pr"}))
+    ] == 2
+    obs_export.detach_scrape(stub)
+    assert stub._scrape is None
+
+
 def test_export_cli_renders_jsonl(tmp_path, capsys):
     path = str(tmp_path / "t.jsonl")
     obs.enable(jsonl_path=path, install_hooks=False)

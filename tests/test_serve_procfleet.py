@@ -13,6 +13,7 @@ promotion) are ``slow``; ``BENCH_FLEET=process`` is their measured
 twin.
 """
 
+import json
 import os
 import signal
 import socket
@@ -300,6 +301,120 @@ def test_single_process_replica_end_to_end(tmp_path):
     wal.close()
     rr, rc, _ = v.E.to_host_coo()
     assert (a, b) in set(zip(rr.tolist(), rc.tolist()))
+
+
+# --- fleet observability plane (round 18, ISSUE 16) --------------------------
+
+
+def test_fleet_observability_plane_end_to_end(tmp_path):
+    """ISSUE 16 acceptance: over a REAL 2-replica subprocess fleet,
+    one sampled request yields ONE stitched trace whose router + IPC +
+    child stage marks telescope exactly to the trace wall (two
+    processes, one clock-skew-safe timeline); heartbeat-piggybacked
+    child snapshots federate into one ``/metrics`` scrape with
+    ``replica=`` labels; and the supervision timeline records the
+    spawns as validated ``fleetlog/v1`` JSONL.  The only spawning
+    round-18 test — everything else in the plane is stub-covered
+    (test_obs.py / test_obs_serve.py)."""
+    import urllib.request
+
+    from combblas_tpu import obs
+    from combblas_tpu.obs import export as obs_export
+    from combblas_tpu.obs import trace as obs_trace
+
+    rows, cols = _coo(41)
+    grid = Grid.make(1, 1)
+    eng = GraphEngine.from_coo(grid, rows, cols, N, kinds=("bfs",),
+                               keep_coo=True)
+    ckpt = str(tmp_path / "boot.npz")
+    checkpoint.save_version(ckpt, eng.version)
+    obs.enable(install_hooks=False)
+    obs_trace.set_sample_rate(1.0)
+    fr = None
+    try:
+        fr = ProcessFleet.from_checkpoint(
+            ckpt, (1, 1), replicas=2, kinds=("bfs",),
+            config=ServeConfig(lane_widths=(1, 2)),
+            wal_dir=str(tmp_path / "wal"),
+            workdir=str(tmp_path / "proc"),
+            hb_interval_s=0.05, hb_timeout_s=5.0,
+            metrics_interval_s=0.05,
+        )
+        t0 = time.perf_counter()
+        lev = fr.submit("bfs", 3).result(timeout=60)["levels"]
+        e2e = time.perf_counter() - t0
+        ref = eng.execute("bfs", np.asarray([3], np.int32))["levels"]
+        np.testing.assert_array_equal(
+            np.asarray(lev), np.asarray(ref)[:, 0]
+        )
+        # ONE stitched trace: router marks + child marks, one record
+        stitched = [r for r in obs_trace.records()
+                    if r["labels"].get("fleet") == "process"]
+        assert len(stitched) == 1
+        (rec,) = stitched
+        stages = [s["stage"] for s in rec["stages"]]
+        assert stages[:2] == ["route", "ipc_send"]  # router-side
+        assert stages[-1] == "ipc_recv"
+        for child_stage in ("queue_wait", "assemble", "execute",
+                            "scatter"):
+            assert child_stage in stages  # shipped back over IPC
+        assert "ipc_wait" in stages  # the residual the child can't see
+        # the telescoping invariant ACROSS the process boundary: the
+        # child contributes durations only, scaled into the router's
+        # observed window, so the stages sum to the wall exactly
+        assert sum(s["s"] for s in rec["stages"]) == pytest.approx(
+            rec["wall_s"], abs=1e-6
+        )
+        assert rec["wall_s"] <= e2e + 0.05
+        assert rec["labels"]["replica"] in (0, 1)
+        assert rec["labels"]["kind"] == "bfs"
+        # metrics federation: both children piggyback registry
+        # snapshots on their heartbeats...
+        deadline = time.time() + 10
+        while time.time() < deadline and not all(
+            rp.last_metrics for rp in fr.replicas
+        ):
+            time.sleep(0.02)
+        assert all(rp.last_metrics for rp in fr.replicas)
+        fr.supervise_once()  # tick emits the heartbeat-age gauges
+        # ...and ONE scrape serves the whole fleet, replica-labeled
+        port = fr.serve_metrics()
+        base = f"http://127.0.0.1:{port}"
+        text = urllib.request.urlopen(
+            f"{base}/metrics", timeout=10
+        ).read().decode()
+        parsed = obs_export.parse_exposition(text)
+        child_reqs = [
+            k for k in parsed
+            if k[0] == "combblas_serve_requests" and 'replica="' in k[1]
+        ]
+        assert child_reqs  # child-process counters, federated
+        assert any(
+            k[0] == "combblas_serve_procfleet_heartbeat_age_s"
+            for k in parsed
+        )
+        hz = json.loads(urllib.request.urlopen(
+            f"{base}/healthz", timeout=10
+        ).read())
+        assert hz["status"] == "ok"
+        sz = json.loads(urllib.request.urlopen(
+            f"{base}/statz", timeout=10
+        ).read())
+        assert sz["fleetlog"]["recorded"] >= 2
+        # supervision timeline: both spawns recorded, schema-valid
+        logged = obs.parse_jsonl(fr.fleetlog.path)
+        assert logged[0]["schema"] == obs.FLEETLOG_SCHEMA
+        spawns = [r for r in logged if r.get("name") == "fleet.spawn"]
+        assert sorted(r["replica"] for r in spawns) == [0, 1]
+        assert all(r["pid"] > 0 for r in spawns)
+    finally:
+        if fr is not None:
+            fr.close(drain=True)
+        obs_trace.set_sample_rate(None)
+        obs_trace.clear()
+        obs.disable()
+        obs.reset()
+    assert fr._scrape is None  # close() stops the scrape thread
 
 
 # --- real-signal chaos (slow; BENCH_FLEET=process is the measured twin) ------
