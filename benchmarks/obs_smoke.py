@@ -205,9 +205,73 @@ def run_stitched(scale: int = 6, edgefactor: int = 4,
     return obs.dump_jsonl()
 
 
+def run_net(scale: int = 6, edgefactor: int = 4,
+            out_path: str | None = None) -> str:
+    """Smallest SOCKET-PATH trace entrypoint (round 19): one in-process
+    ``Server`` behind a ``NetFrontend`` TCP listener, one sampled BFS
+    request through a real ``NetClient`` connection — the dump carries
+    a schema-``trace`` record whose stages span the wire
+    (``net_accept -> net_read -> queue/assemble/execute ->
+    net_write``) and still sum to the request wall.
+
+        JAX_PLATFORMS=cpu python benchmarks/obs_smoke.py --net
+    """
+    import numpy as np
+
+    from combblas_tpu import obs
+    from combblas_tpu.obs import trace as obs_trace
+    from combblas_tpu.parallel.grid import Grid
+    from combblas_tpu.serve import (
+        GraphEngine,
+        NetClient,
+        NetFrontend,
+        ServeConfig,
+    )
+    from combblas_tpu.utils.rmat import rmat_symmetric_coo_host
+
+    if out_path is None:
+        out_path = os.path.join(
+            tempfile.gettempdir(), "obs_smoke_net.jsonl"
+        )
+    obs.enable(jsonl_path=out_path, install_hooks=False)
+    prev_rate = obs_trace.sample_rate()
+    obs_trace.set_sample_rate(1.0)
+    n = 1 << scale
+    rows, cols = rmat_symmetric_coo_host(42, scale, edgefactor)
+    engine = GraphEngine.from_coo(
+        Grid.make(1, 1), rows, cols, n, kinds=("bfs",)
+    )
+    srv = engine.serve(
+        ServeConfig(lane_widths=(1, 2), update_autostart=False)
+    )
+    srv.start()
+    srv.warmup(widths=(1, 2))
+    fe = NetFrontend(srv)
+    try:
+        deg = np.bincount(rows, minlength=n)
+        root = int(np.flatnonzero(deg > 0)[0])
+        with NetClient("127.0.0.1", fe.port) as client:
+            client.submit("bfs", root, timeout_s=120.0)
+        for rec in obs_trace.records():
+            if rec["labels"].get("transport") == "net":
+                stages = " -> ".join(
+                    s["stage"] for s in rec["stages"]
+                )
+                print(f"net [{stages}] wall_s={rec['wall_s']:.4f}")
+    finally:
+        fe.close()
+        srv.close()
+        obs_trace.set_sample_rate(prev_rate)
+    return obs.dump_jsonl()
+
+
 def main():
-    argv = [a for a in sys.argv[1:] if a != "--stitched"]
-    entry = run_stitched if "--stitched" in sys.argv[1:] else run
+    flags = {"--stitched": run_stitched, "--net": run_net}
+    argv = [a for a in sys.argv[1:] if a not in flags]
+    entry = run
+    for flag, fn in flags.items():
+        if flag in sys.argv[1:]:
+            entry = fn
     out = entry(out_path=argv[0] if argv else None)
     from combblas_tpu import obs
 

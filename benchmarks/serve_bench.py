@@ -93,6 +93,15 @@ scenario, plus the first honest replica-parallelism measurement:
 read-only throughput through N subprocess replicas (own JAX runtimes,
 no shared exec lock) vs the SAME stream through the thread fleet's
 shared-lock serialization.  Results under benchmarks/results/r17/.
+
+BENCH_SERVE_NET=1 runs the OPEN-LOOP network scenario (round 19) by
+delegating to ``combblas_tpu.serve.net.loadgen``: a seeded Poisson
+arrival stream over hundreds of TCP connections against a process
+fleet, latencies measured from SCHEDULED arrival time.  Every
+scenario in THIS file is closed-loop (the next request waits for the
+last), so each summary carries ``warning: "closed-loop (coordinated
+omission)"`` — do not compare its tail latencies against the
+open-loop numbers (results under benchmarks/results/r19/).
 """
 
 from __future__ import annotations
@@ -298,6 +307,7 @@ def run(scale: int = SCALE, edgefactor: int = EDGEFACTOR,
     speedup = qps_batch / qps_base if qps_base else float("inf")
     out = {
         "metric": "serve_throughput",
+        "warning": "closed-loop (coordinated omission)",
         "unit": "queries/s",
         "value": round(qps_batch, 2),
         "qps_batched": round(qps_batch, 2),
@@ -433,6 +443,7 @@ def run_chaos(scale: int = SCALE, edgefactor: int = EDGEFACTOR,
 
     out = {
         "metric": "serve_chaos_availability",
+        "warning": "closed-loop (coordinated omission)",
         "unit": "fraction_ok",
         "value": round(availability, 4),
         "availability_pct": round(100 * availability, 2),
@@ -622,6 +633,7 @@ def run_mutate(scale: int = SCALE, edgefactor: int = EDGEFACTOR,
     )
     out = {
         "metric": "serve_mutate_amortization",
+        "warning": "closed-loop (coordinated omission)",
         "unit": "rebuild_over_incremental",
         "value": round(amortization, 2) if amortization else None,
         "ok": ok,
@@ -916,6 +928,7 @@ def run_pool(scale: int = SCALE, edgefactor: int = EDGEFACTOR,
     )
     out = {
         "metric": "serve_pool_throughput",
+        "warning": "closed-loop (coordinated omission)",
         "unit": "queries/s",
         "value": round(qps, 2),
         "ok": ok,
@@ -1114,6 +1127,7 @@ def run_recovery(scale: int = SCALE, edgefactor: int = EDGEFACTOR,
 
     out = {
         "metric": "serve_recovery_availability",
+        "warning": "closed-loop (coordinated omission)",
         "unit": "fraction_ok",
         "value": round(availability, 4),
         "availability_pct": round(100 * availability, 2),
@@ -1380,6 +1394,7 @@ def run_recovery_process(scale: int = SCALE,
 
     out = {
         "metric": "serve_recovery_process_availability",
+        "warning": "closed-loop (coordinated omission)",
         "unit": "fraction_ok",
         "value": round(availability, 4),
         "availability_pct": round(100 * availability, 2),
@@ -1474,6 +1489,12 @@ def _emit_pool_summary(out: dict) -> int:
 
 
 def main():
+    if os.environ.get("BENCH_SERVE_NET") == "1":
+        # the open-loop net harness owns its own headline emission
+        # (same contract, same BENCH_EMIT_SUMMARY=0 child-runner rule)
+        from combblas_tpu.serve.net import loadgen
+
+        sys.exit(loadgen.main())
     if os.environ.get("BENCH_SERVE_POOL") == "1":
         out = run_pool()
         print(json.dumps(out), flush=True)

@@ -920,6 +920,48 @@ scrape via the heartbeat-piggybacked registry snapshots):
                                                      fleetlog/v1`` log
                                                      (labels ``event``)
 ========================================  =========  ==================
+
+Network front door (round 19, serve/net/ — the TCP frontend; wire
+byte/serialization accounting rides the shared ``serve.ipc.*`` series
+above with ``peer="net"`` / ``peer="netclient"``, one codec for both
+transports):
+
+========================================  =========  ==================
+``serve.net.connections``                 gauge      currently-open
+                                                     admitted
+                                                     connections
+``serve.net.accept_queue``                gauge      connections
+                                                     accepted but still
+                                                     mid-handshake
+                                                     (hello pending)
+``serve.net.requests``                    counter    request frames
+                                                     dispatched (labels
+                                                     ``op``)
+``serve.net.bytes_in`` /                  counter    wire bytes per
+``serve.net.bytes_out``                              reply direction
+                                                     incl. the length
+                                                     prefix (derived
+                                                     from the channel
+                                                     byte totals)
+``serve.net.status``                      counter    replies by
+                                                     protocol status
+                                                     code (labels
+                                                     ``code`` — the
+                                                     error-taxonomy
+                                                     wire mapping;
+                                                     rejections are
+                                                     COUNTED wire
+                                                     replies, never
+                                                     dropped
+                                                     connections)
+``serve.net.reply_drops``                 counter    replies whose
+                                                     connection was
+                                                     gone at send time
+                                                     (the request still
+                                                     settled — dropped
+                                                     reply, not a
+                                                     stranded future)
+========================================  =========  ==================
 """
 
 from __future__ import annotations

@@ -80,7 +80,7 @@ class RequestTrace:
     the trace and commits it to the bounded log."""
 
     __slots__ = ("rid", "name", "labels", "ts", "t0", "_last",
-                 "stages", "_done")
+                 "stages", "_done", "_held", "_held_status")
 
     def __init__(self, rid, name: str, labels: dict):
         self.rid = rid
@@ -91,6 +91,8 @@ class RequestTrace:
         self._last = self.t0
         self.stages: list[list] = []  # [stage, seconds], ordered
         self._done = False
+        self._held = False
+        self._held_status = None
 
     def mark(self, stage: str, now: float | None = None) -> float:
         now = time.perf_counter() if now is None else now
@@ -109,6 +111,30 @@ class RequestTrace:
         graph version, ...) discovered after admission."""
         self.labels.update(labels)
 
+    def hold(self) -> None:
+        """Defer the commit past the next ``finish`` (round 19): a
+        transport that wraps the serve path — the net frontend writes
+        the reply AFTER the router/scheduler settles the request —
+        needs to charge its tail stage (``net_write``) after the
+        downstream layer has already called ``finish``.  While held,
+        the first ``finish`` marks its tail stage and records the
+        status but does NOT commit; :meth:`release` appends the
+        transport tail and commits with that recorded status, so the
+        ``sum(stages) == wall_s`` invariant survives the hand-off."""
+        self._held = True
+
+    def release(self, status: str | None = None,
+                stage: str | None = None) -> None:
+        """Close a held trace: charge ``stage`` (the transport tail)
+        and commit under the status the downstream ``finish`` recorded
+        (falling back to ``status``, then "ok")."""
+        if self._done:
+            return
+        self._held = False
+        st = self._held_status or status or "ok"
+        self._held_status = None
+        self.finish(status=st, stage=stage)
+
     def finish(self, status: str = "ok", stage: str | None = None
                ) -> None:
         """Close the trace (idempotent — the first settle wins, like
@@ -116,6 +142,13 @@ class RequestTrace:
         (last mark -> now) under that name, so the stage sum stays
         equal to the end-to-end wall time."""
         if self._done:
+            return
+        if self._held:
+            if self._held_status is None:  # first settle wins
+                self._held_status = status
+                if stage is not None:
+                    self.mark(stage)
+                self.labels["status"] = status
             return
         self._done = True
         if stage is not None:

@@ -62,6 +62,17 @@ batches". Four layers (docs/serving.md has the full architecture):
    over IPC at the WAL frontier, versions fan out as checkpoint
    files (never pickled arrays), and ``ProcessFaultPlan`` scripts
    real SIGKILL/SIGSTOP chaos deterministically.
+9. **net** (`net/`, round 19) — ``NetFrontend``/``NetClient``: the
+   TCP front door — a versioned request/reply protocol over the
+   shared frame codec (``frame.py``, factored out of ``ipc.py`` so
+   procfleet and net speak ONE codec over two transports), fronting
+   any backend above: tenant-header routing into the pool, wire
+   deadlines propagating into the SLO budget, the whole error
+   taxonomy mapped onto typed protocol status codes (a rejection is
+   a wire reply, never a dropped connection), and the open-loop
+   Poisson load harness (``net/loadgen.py``, ``BENCH_SERVE_NET=1``)
+   whose latencies are measured from scheduled arrival time — no
+   coordinated omission.
 
 Everything is wired into ``combblas_tpu.obs`` (queue-depth gauge,
 occupancy/padding-waste/latency histograms, plan-cache and
@@ -89,6 +100,7 @@ from .api import Server
 from .pool import EnginePool, PoolServer
 from .fleet import FleetRouter, ReplicaDeadError
 from .procfleet import IpcTimeoutError, ProcessFleet, ReplicaProc
+from .net import NetClient, NetFrontend
 from .slo import ErrorBudget
 
 __all__ = [
@@ -97,6 +109,7 @@ __all__ = [
     "DeficitRoundRobin", "EnginePool", "PoolServer", "FleetRouter",
     "ReplicaDeadError",
     "ProcessFleet", "ReplicaProc", "IpcTimeoutError",
+    "NetFrontend", "NetClient",
     "FaultInjector", "InjectedFault", "ProcessFaultPlan",
     "FAULT_POINTS", "ErrorBudget",
     "Request", "KINDS",

@@ -1,0 +1,241 @@
+"""Transport-agnostic length-prefixed JSON+binary frame codec (r19).
+
+Factored out of ``serve/ipc.py`` (round 17) so ONE implementation
+serves both transports: the process fleet's parent<->child
+``socketpair`` channels (``serve/procfleet.py``) and the network front
+door's TCP connections (``serve/net/``).  Every frame is::
+
+    [4B total_len] [4B header_len] [header JSON] [binary blobs]
+
+Framing means a reader can never consume half a message; a peer that
+dies mid-frame produces a clean ``ChannelClosed`` on the next read,
+never a poisoned stream — the on-disk analog is the WAL's
+torn-final-line tolerance.
+
+The header is one UTF-8 JSON object (debuggable, pickle-free — a
+peer crash can corrupt its own heap, not ours).  Numpy arrays do NOT
+ride as JSON lists: :func:`encode` hoists them into the frame's binary
+section as raw contiguous bytes and leaves an
+``{"__ndb__": dtype, "shape": [...], "off": n, "nbytes": n}``
+envelope in the header; :func:`decode` rebuilds them with
+``np.frombuffer`` — a memcpy, not a float-parse.  That keeps a
+pagerank reply (one n-vector per query) at wire cost ~= its array
+bytes, which is what lets the serving read path stay exec-bound
+instead of serialization-bound.
+
+Big payloads (graph versions) still NEVER ride a channel: they travel
+as ``save_version`` checkpoint files on disk and the message carries
+the path (``swap_from_checkpoint``), so the wire layer stays
+latency-bound, not bandwidth-bound.
+
+The obs accounting series keep their round-18 ``serve.ipc.*`` names
+(``serve.ipc.bytes_out/bytes_in/encode_s/decode_s``, labeled by
+``peer``) for BOTH transports — one codec, one set of dashboards;
+net-specific totals (``serve.net.bytes_in/bytes_out``) are derived by
+the frontend from the per-channel byte counters below.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+
+from .. import obs
+
+#: Hard cap on one frame — a corrupt length prefix must not allocate
+#: gigabytes; real messages are query results (KBs).
+MAX_FRAME = 64 << 20
+
+
+class ChannelClosed(ConnectionError):
+    """The peer closed (or broke) the socket — for a replica channel
+    this is crash detection, handled by quarantine + respawn; for a
+    net connection it is client disconnect, handled by connection
+    cleanup (in-flight replies are dropped, never stranded)."""
+
+
+def _headerable(obj, blobs: list):
+    """JSON-safe header view of ``obj``: ndarrays hoist their bytes
+    into ``blobs`` and leave an ``__ndb__`` envelope; numpy scalars
+    become Python scalars."""
+    if isinstance(obj, np.ndarray):
+        a = np.ascontiguousarray(obj)
+        off = sum(len(b) for b in blobs)
+        blobs.append(a.tobytes())
+        return {
+            "__ndb__": a.dtype.str,
+            "shape": list(a.shape),
+            "off": off,
+            "nbytes": a.nbytes,
+        }
+    if isinstance(obj, (np.integer,)):
+        return int(obj)
+    if isinstance(obj, (np.floating,)):
+        return float(obj)
+    if isinstance(obj, (np.bool_,)):
+        return bool(obj)
+    if isinstance(obj, dict):
+        return {str(k): _headerable(v, blobs) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_headerable(v, blobs) for v in obj]
+    if isinstance(obj, (str, int, float, bool)) or obj is None:
+        return obj
+    # device arrays and anything else array-like: one host transfer
+    try:
+        return _headerable(np.asarray(obj), blobs)
+    except Exception:
+        return repr(obj)
+
+
+def encode(obj) -> bytes:
+    """One frame body: ``[4B header_len][header][blobs]``."""
+    blobs: list = []
+    head = json.dumps(
+        _headerable(obj, blobs), separators=(",", ":")
+    ).encode("utf-8")
+    return b"".join([struct.pack(">I", len(head)), head, *blobs])
+
+
+def decode(data: bytes) -> dict:
+    (hl,) = struct.unpack(">I", data[:4])
+    head = json.loads(data[4:4 + hl].decode("utf-8"))
+    binary = memoryview(data)[4 + hl:]
+    return _denumpy(head, binary)
+
+
+def _denumpy(obj, binary):
+    if isinstance(obj, dict):
+        if "__ndb__" in obj:
+            off, nb = int(obj["off"]), int(obj["nbytes"])
+            return np.frombuffer(
+                binary[off:off + nb], dtype=np.dtype(obj["__ndb__"])
+            ).reshape(obj["shape"]).copy()  # own the memory: the
+            # frame buffer is released after decode
+        return {k: _denumpy(v, binary) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_denumpy(v, binary) for v in obj]
+    return obj
+
+
+def denumpy(obj):
+    """Identity helper kept for callers that post-process decoded
+    replies (decode() already rebuilt the arrays)."""
+    return obj
+
+
+class Channel:
+    """One framed JSON duplex channel over a connected socket.
+
+    ``send`` is thread-safe (the reply path and the heartbeat thread
+    share the child's channel; the router's request path and its
+    supervisor share the parent's; the frontend's reply callbacks
+    share a connection's) and returns the wire length of the frame it
+    wrote.  ``recv`` is single-reader by design — each side owns
+    exactly one reader thread/loop.
+
+    ``peer`` labels the round-18 channel accounting series
+    (``serve.ipc.bytes_out/bytes_in/encode_s/decode_s``) so the
+    isolation tax is attributable per peer class; obs disabled costs
+    one attribute read per frame.  ``bytes_out``/``bytes_in`` integer
+    totals are maintained unconditionally (plain int adds) so
+    transports can derive their own byte series without a second
+    count at this layer.
+    """
+
+    def __init__(self, sock: socket.socket, peer: str | None = None):
+        self._sock = sock
+        self._lab = {"peer": peer} if peer else {}
+        self._wlock = threading.Lock()
+        self._closed = False
+        # wire totals including the 4B length prefix; bytes_in only
+        # advances on whole decoded frames (the single reader may hold
+        # a partial frame in _rbuf — not yet a message, not counted)
+        self.bytes_out = 0
+        self.bytes_in = 0
+        # partial-frame accumulator: a recv() that times out MID-FRAME
+        # keeps what it read here, so the next call resumes the same
+        # frame instead of desyncing (a slow peer mid-sendall — GIL
+        # stall, compile, SIGSTOP+SIGCONT — is a late frame, not a
+        # broken stream)
+        self._rbuf = b""
+
+    def send(self, obj: dict) -> int:
+        if obs.ENABLED:
+            t0 = time.perf_counter()
+            data = encode(obj)
+            obs.observe(
+                "serve.ipc.encode_s", time.perf_counter() - t0, **self._lab
+            )
+            obs.count("serve.ipc.bytes_out", len(data) + 4, **self._lab)
+        else:
+            data = encode(obj)
+        if len(data) > MAX_FRAME:
+            raise ValueError(
+                f"ipc frame too large ({len(data)} bytes); ship big "
+                "payloads as checkpoint files, not messages"
+            )
+        frame = struct.pack(">I", len(data)) + data
+        with self._wlock:
+            if self._closed:
+                raise ChannelClosed("channel closed")
+            try:
+                self._sock.sendall(frame)
+            except (OSError, ValueError) as e:
+                raise ChannelClosed(f"peer gone: {e}") from e
+            self.bytes_out += len(frame)
+        return len(frame)
+
+    def recv(self, timeout: float | None = None) -> dict:
+        """One message; ``socket.timeout`` when a whole frame has not
+        arrived within ``timeout`` (the reader loop's poll tick —
+        partial bytes are RETAINED, so a timeout can never desync the
+        framing), ``ChannelClosed`` on EOF/reset/corrupt prefix."""
+        self._sock.settimeout(timeout)
+        while True:
+            if len(self._rbuf) >= 4:
+                (n,) = struct.unpack(">I", self._rbuf[:4])
+                if n > MAX_FRAME:
+                    raise ChannelClosed(f"oversized frame ({n} bytes)")
+                if len(self._rbuf) >= 4 + n:
+                    data = self._rbuf[4:4 + n]
+                    self._rbuf = self._rbuf[4 + n:]
+                    self.bytes_in += n + 4
+                    if obs.ENABLED:
+                        t0 = time.perf_counter()
+                        msg = decode(data)
+                        obs.observe(
+                            "serve.ipc.decode_s",
+                            time.perf_counter() - t0,
+                            **self._lab,
+                        )
+                        obs.count(
+                            "serve.ipc.bytes_in", len(data) + 4, **self._lab
+                        )
+                        return msg
+                    return decode(data)
+            try:
+                c = self._sock.recv(1 << 16)
+            except socket.timeout:
+                raise  # partial frame stays buffered for the next call
+            except (OSError, ValueError) as e:
+                raise ChannelClosed(f"peer gone: {e}") from e
+            if not c:
+                raise ChannelClosed("peer closed the channel")
+            self._rbuf += c
+
+    def close(self) -> None:
+        with self._wlock:
+            self._closed = True
+            try:
+                self._sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                self._sock.close()
+            except OSError:
+                pass

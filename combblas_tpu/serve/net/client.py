@@ -1,0 +1,186 @@
+"""``NetClient`` — the blocking wire client for the net front door.
+
+One TCP connection, one background reader thread matching pipelined
+replies to futures by ``id``.  The blocking calls
+(``submit``/``submit_many``/``submit_update``/``stats``/``health``)
+wrap the ``*_nowait`` future primitives the open-loop load generator
+drives directly (an open-loop harness must SEND on schedule, never
+block on completions — ``submit_nowait`` is that send).
+
+Typed failures come back as the SAME exception types an in-process
+caller sees (``protocol.wire_exception``): a ``backpressure`` reply
+raises ``BackpressureError`` with its ``retry_after_s`` hint intact.
+A dropped connection fails every pending future with
+``ConnectionError`` — stranded futures are impossible by construction
+(the reader thread owns the pending map's teardown).
+"""
+
+from __future__ import annotations
+
+import itertools
+import socket
+import threading
+from concurrent.futures import Future
+
+from ..frame import Channel
+from . import protocol as P
+
+
+class NetClient:
+    """Blocking client for one ``NetFrontend`` connection."""
+
+    def __init__(self, host: str, port: int, *,
+                 tenant: str | None = None,
+                 connect_timeout_s: float = 10.0):
+        sock = socket.create_connection(
+            (host, port), timeout=connect_timeout_s
+        )
+        self.ch = Channel(sock, peer="netclient")
+        self.tenant = tenant
+        self._pending: dict[int, Future] = {}
+        self._plock = threading.Lock()
+        self._rid = itertools.count(1)
+        self._closed = False
+        self.ch.send({
+            "v": P.PROTOCOL_VERSION, "op": "hello", "id": 0,
+            "tenant": tenant,
+        })
+        hello = self.ch.recv(timeout=connect_timeout_s)
+        if hello.get("status") != P.ST_OK:
+            self.ch.close()
+            raise P.wire_exception(hello)
+        self.server_pooled = bool(hello.get("pooled"))
+        self._reader = threading.Thread(
+            target=self._read_loop, daemon=True,
+            name=f"combblas-net-client:{port}",
+        )
+        self._reader.start()
+
+    # -- reader ------------------------------------------------------------
+
+    def _read_loop(self) -> None:
+        while True:
+            try:
+                m = self.ch.recv(timeout=0.25)
+            except socket.timeout:
+                continue
+            except Exception as e:
+                self._fail_all(ConnectionError(
+                    "connection closed" if self._closed
+                    else f"server gone: {e}"
+                ))
+                return
+            if not isinstance(m, dict):
+                continue
+            with self._plock:
+                fut = self._pending.pop(m.get("id"), None)
+            if fut is None:
+                continue  # reply for an id we never sent (or re-sent)
+            if m.get("status") == P.ST_OK:
+                if not fut.set_running_or_notify_cancel():
+                    continue
+                if "results" in m:
+                    fut.set_result(m["results"])
+                else:
+                    fut.set_result(m.get("result"))
+            else:
+                if fut.set_running_or_notify_cancel():
+                    fut.set_exception(P.wire_exception(m))
+
+    def _fail_all(self, exc: Exception) -> None:
+        with self._plock:
+            pending = list(self._pending.values())
+            self._pending.clear()
+        for f in pending:
+            if f.set_running_or_notify_cancel():
+                f.set_exception(exc)
+
+    # -- send primitives (open-loop harness drives these) ------------------
+
+    def _send(self, msg: dict) -> Future:
+        fut: Future = Future()
+        mid = next(self._rid)
+        msg["id"] = mid
+        with self._plock:
+            if self._closed:
+                raise ConnectionError("client closed")
+            self._pending[mid] = fut
+        try:
+            self.ch.send(msg)
+        except Exception as e:
+            with self._plock:
+                self._pending.pop(mid, None)
+            raise ConnectionError(f"send failed: {e}") from e
+        return fut
+
+    def submit_nowait(self, kind: str, root,
+                      deadline_s: float | None = None) -> Future:
+        """Send one query WITHOUT waiting; the Future resolves to the
+        result dict or raises the typed rejection."""
+        msg: dict = {"op": "submit", "kind": kind, "root": root}
+        if deadline_s is not None:
+            msg["deadline_s"] = deadline_s
+        return self._send(msg)
+
+    def submit_many_nowait(self, kind: str, roots,
+                           deadline_s: float | None = None) -> Future:
+        msg: dict = {
+            "op": "submit_many", "kind": kind, "roots": list(roots),
+        }
+        if deadline_s is not None:
+            msg["deadline_s"] = deadline_s
+        return self._send(msg)
+
+    def submit_update_nowait(self, ops) -> Future:
+        return self._send({
+            "op": "submit_update", "ops": [list(o) for o in ops],
+        })
+
+    # -- blocking API ------------------------------------------------------
+
+    def submit(self, kind: str, root, deadline_s: float | None = None,
+               timeout_s: float = 120.0) -> dict:
+        return self.submit_nowait(
+            kind, root, deadline_s=deadline_s
+        ).result(timeout=timeout_s)
+
+    def submit_many(self, kind: str, roots,
+                    deadline_s: float | None = None,
+                    timeout_s: float = 120.0) -> list[dict]:
+        """One entry per root, in order: ``{"status": "ok", "result":
+        {...}}`` or the typed wire-error dict — per-root failure
+        isolation survives the wire without torn batches."""
+        return self.submit_many_nowait(
+            kind, roots, deadline_s=deadline_s
+        ).result(timeout=timeout_s)
+
+    def submit_update(self, ops, timeout_s: float = 120.0) -> dict:
+        return self.submit_update_nowait(ops).result(timeout=timeout_s)
+
+    def stats(self, timeout_s: float = 30.0) -> dict:
+        return self._send({"op": "stats"}).result(timeout=timeout_s)
+
+    def health(self, timeout_s: float = 30.0) -> dict:
+        return self._send({"op": "health"}).result(timeout=timeout_s)
+
+    def ping(self, timeout_s: float = 30.0) -> dict:
+        return self._send({"op": "ping"}).result(timeout=timeout_s)
+
+    @property
+    def pending(self) -> int:
+        with self._plock:
+            return len(self._pending)
+
+    def close(self) -> None:
+        """Close the socket; pending futures fail with
+        ``ConnectionError`` (reader-thread teardown — never stranded)."""
+        self._closed = True
+        self.ch.close()
+        self._reader.join(timeout=5.0)
+        self._fail_all(ConnectionError("client closed"))
+
+    def __enter__(self) -> "NetClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

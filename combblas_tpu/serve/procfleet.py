@@ -697,15 +697,22 @@ class ProcessFleet(ReplicaFleetBase):
     # -- read path: the shared policy + scripted process chaos -------------
 
     def submit(self, kind: str, root, timeout_s: float | None = None,
-               read_retry: int = 1):
+               read_retry: int = 1, trace=None):
         for signame, rep in self.proc_faults.step():
             self._apply_fault(signame, rep)
         # cross-process trace stitching: one deterministic sampling
         # decision at the FRONT DOOR (obs.request_trace gates on
         # ENABLED + sample rate), handed to the routed replica via
         # thread-local; the child traces unconditionally under this
-        # rid, so both halves of the stitched record correlate
-        tr = obs.request_trace(next(self._trace_rid), kind=kind)
+        # rid, so both halves of the stitched record correlate.
+        # Round 19: when the NET frontend already opened (and holds) a
+        # trace at the socket, adopt it — the sampler rolled once at
+        # the outermost door, and the child's marks stitch into the
+        # same record that carries net_accept/net_read/net_write.
+        tr = (
+            trace if trace is not None
+            else obs.request_trace(next(self._trace_rid), kind=kind)
+        )
         if tr is None:
             return super().submit(
                 kind, root, timeout_s=timeout_s, read_retry=read_retry

@@ -60,6 +60,21 @@ os.environ["COMBBLAS_CHECKPOINT_RETAIN"] = "0"
 os.environ["COMBBLAS_FLEETLOG"] = "0"
 os.environ["COMBBLAS_OBS_HB_METRICS_S"] = "0"
 
+# Hermetic net-frontend knobs (round 19): an ambient COMBBLAS_NET_PORT
+# would make every test NetFrontend bind a FIXED operator port (two
+# tests in one run would collide on EADDRINUSE), ambient conn/backlog
+# caps would change the backpressure tests' admission points, and
+# ambient BENCH_NET_* rates would re-scale the slow open-loop harness
+# test — pin the defaults ("0" = default per the tuner/config
+# convention: port 0 means ephemeral); tests that exercise the knobs
+# pass explicit arguments or monkeypatch instead.
+os.environ["COMBBLAS_NET_PORT"] = "0"
+os.environ["COMBBLAS_NET_MAX_CONNS"] = "0"
+os.environ["COMBBLAS_NET_ACCEPT_BACKLOG"] = "0"
+os.environ["BENCH_NET_RATE"] = "0"
+os.environ["BENCH_NET_CONNS"] = "0"
+os.environ["BENCH_NET_SECONDS"] = "0"
+
 # Hermetic trace sampling (round 15): an ambient
 # COMBBLAS_OBS_TRACE_SAMPLE would make every obs-enabled serve test
 # also record per-request traces (and their ``serve.trace.sampled``

@@ -152,6 +152,64 @@ def test_fleet_obs_knobs_centralized(monkeypatch, tmp_path):
     )
 
 
+def test_net_knobs_centralized(monkeypatch):
+    """The round-19 net-frontend + open-loop-bench knobs parse through
+    tuner/config with the shared conventions: unset/"0" = default
+    (port 0 = ephemeral bind), explicit argument beats the env, the
+    count knobs clamp sane, and a bogus value raises NAMING the
+    knob."""
+    import pytest
+
+    from combblas_tpu.tuner import config
+
+    for name in (
+        config.ENV_NET_PORT, config.ENV_NET_MAX_CONNS,
+        config.ENV_NET_ACCEPT_BACKLOG,
+    ):
+        assert name.startswith("COMBBLAS_")
+    for name in (
+        config.ENV_BENCH_NET_RATE, config.ENV_BENCH_NET_CONNS,
+        config.ENV_BENCH_NET_SECONDS,
+    ):
+        assert name.startswith("BENCH_NET_")
+    # conftest pins these to "0" => defaults: ephemeral port, default
+    # conn/backlog caps, default open-loop shape
+    assert config.net_port() == config.DEFAULT_NET_PORT == 0
+    assert config.net_max_conns() == config.DEFAULT_NET_MAX_CONNS
+    assert config.net_accept_backlog() == config.DEFAULT_NET_ACCEPT_BACKLOG
+    assert config.bench_net_rate() == config.DEFAULT_BENCH_NET_RATE
+    assert config.bench_net_conns() == config.DEFAULT_BENCH_NET_CONNS
+    assert config.bench_net_seconds() == config.DEFAULT_BENCH_NET_SECONDS
+    monkeypatch.setenv(config.ENV_NET_PORT, "19219")
+    monkeypatch.setenv(config.ENV_NET_MAX_CONNS, "64")
+    monkeypatch.setenv(config.ENV_NET_ACCEPT_BACKLOG, "16")
+    monkeypatch.setenv(config.ENV_BENCH_NET_RATE, "50.5")
+    monkeypatch.setenv(config.ENV_BENCH_NET_CONNS, "32")
+    monkeypatch.setenv(config.ENV_BENCH_NET_SECONDS, "2.5")
+    assert config.net_port() == 19219
+    assert config.net_max_conns() == 64
+    assert config.net_accept_backlog() == 16
+    assert config.bench_net_rate() == 50.5
+    assert config.bench_net_conns() == 32
+    assert config.bench_net_seconds() == 2.5
+    # argument > env, clamped sane
+    assert config.net_port(0) == 0
+    assert config.net_max_conns(1) == 1
+    assert config.net_max_conns(-3) == 1  # clamp >= 1
+    assert config.net_accept_backlog(-1) == 1
+    assert config.bench_net_conns(0) == config.DEFAULT_BENCH_NET_CONNS
+    assert config.bench_net_rate(0.01) == 0.1  # clamp >= 0.1
+    # vetting raises NAMING the knob
+    with pytest.raises(ValueError, match=config.ENV_NET_PORT):
+        config.net_port(70000)
+    with pytest.raises(ValueError, match=config.ENV_NET_PORT):
+        config.net_port("not-a-port")
+    with pytest.raises(ValueError, match=config.ENV_NET_MAX_CONNS):
+        config.net_max_conns("many")
+    with pytest.raises(ValueError, match=config.ENV_BENCH_NET_RATE):
+        config.bench_net_rate("fast")
+
+
 def test_pool_fleet_knobs_centralized(monkeypatch):
     """The round-14 pool/fleet knobs parse through tuner/config with
     the shared conventions (unset/empty/"0" = default; explicit
