@@ -102,6 +102,16 @@ scenario in THIS file is closed-loop (the next request waits for the
 last), so each summary carries ``warning: "closed-loop (coordinated
 omission)"`` — do not compare its tail latencies against the
 open-loop numbers (results under benchmarks/results/r19/).
+
+BENCH_SERVE_SHARD=1 runs the SHARDED SERVING scenario (round 20): one
+graph row-partitioned over ``BENCH_SHARD_SLICES`` (default 2)
+subprocess slices (each a rectangular slab on its own JAX runtime),
+served as ONE engine through the batcher.  Gates: per-slice device
+residency <= 60% of the unsharded build, bfs/sssp bit-exact vs
+unsharded (before AND after a slice SIGKILL+respawn), availability
+>= 99% through the kill, zero post-warmup retraces across the
+respawn, and two-phase writes + whole-service recovery reassembling
+the identical global COO.  Results under benchmarks/results/r20/.
 """
 
 from __future__ import annotations
@@ -1463,6 +1473,215 @@ def run_recovery_process(scale: int = SCALE,
     return out
 
 
+def run_shard(scale: int = SCALE, edgefactor: int = EDGEFACTOR) -> dict:
+    """BENCH_SERVE_SHARD=1 — cross-host sharded serving (module
+    docstring): partition scaling, bit-exactness, one-slice
+    SIGKILL+respawn availability, zero post-warmup retraces, durable
+    writes and whole-service recovery."""
+    import tempfile
+    import threading
+
+    import numpy as np
+
+    from combblas_tpu import obs
+    from combblas_tpu.dynamic import DeltaBatch
+    from combblas_tpu.parallel.grid import Grid
+    from combblas_tpu.serve import (
+        GraphEngine,
+        ServeConfig,
+        ShardedEngine,
+    )
+
+    sidecar = obs.enable_sidecar("serve-shard")
+    nslices = int(os.environ.get("BENCH_SHARD_SLICES", "2"))
+    nqueries = int(os.environ.get("BENCH_SERVE_QUERIES", "200"))
+    nwrites = int(os.environ.get("BENCH_SHARD_WRITES", "8"))
+    mode = os.environ.get("BENCH_SHARD_MODE", "process")
+    home = tempfile.mkdtemp(prefix="combblas-shard-bench-")
+
+    n = 1 << scale
+    from combblas_tpu.utils.rmat import rmat_symmetric_coo_host
+
+    rows, cols = rmat_symmetric_coo_host(42, scale, edgefactor)
+    rng = np.random.default_rng(7)
+    weights = (rng.random(len(rows)) + 0.1).astype(np.float32)
+    kinds = ("bfs", "sssp")
+    deg = np.bincount(rows, minlength=n)
+    roots = rng.choice(np.flatnonzero(deg > 0), size=nqueries)
+    stream = [
+        (kinds[i % len(kinds)], int(r)) for i, r in enumerate(roots)
+    ]
+    probe = np.asarray(roots[:8], np.int32)
+
+    # -- the unsharded comparator (also the bit-exactness oracle) --------
+    grid = Grid.make(1, 1)
+    eng = GraphEngine.from_coo(
+        grid, rows, cols, n, weights=weights, kinds=kinds,
+        keep_coo=True,
+    )
+    unsharded_bytes = int(eng.version.device_bytes())
+    ref = {k: eng.execute(k, probe) for k in kinds}
+
+    t0 = time.perf_counter()
+    sh = ShardedEngine.build(
+        rows, cols, nrows=n, nslices=nslices, weights=weights,
+        kinds=kinds, home=home, mode=mode, warmup=True,
+        hb_interval_s=0.1, hb_timeout_s=2.0,
+    )
+    boot_s = time.perf_counter() - t0
+    per_slice = [int(b) for b in sh.version.device_bytes_per_slice]
+    bytes_ratio = max(per_slice) / unsharded_bytes
+
+    def _bit_exact() -> bool:
+        for kind, key in (("bfs", "parents"), ("sssp", "dist")):
+            got = sh.execute(kind, probe)
+            if not np.array_equal(np.asarray(ref[kind][key]),
+                                  np.asarray(got[key])):
+                return False
+            if kind == "bfs" and int(
+                ref[kind]["batch_niter"]
+            ) != int(got["batch_niter"]):
+                return False
+        return True
+
+    exact_before = _bit_exact()
+
+    # -- closed-loop stream through the batcher, one slice SIGKILLed
+    #    mid-stream while the supervisor heals it ------------------------
+    mark = sh.trace_mark()
+    srv = sh.serve(ServeConfig(
+        lane_widths=(1, 2, 4, 8, 16),
+        max_queue=max(64, nqueries), max_wait_s=0.005,
+        update_flush=1,
+    ))
+    srv.start()
+    sh.start_supervisor(interval_s=0.05)
+    kill_at = nqueries // 2
+    victim = 0
+    ok = failed = 0
+    lat: list[float] = []
+    t0 = time.perf_counter()
+    for i, (kind, root) in enumerate(stream):
+        if i == kill_at:
+            sh.slices[victim].kill()  # SIGKILL under load
+        ts = time.monotonic()
+        try:
+            srv.submit(kind, root).result(timeout=120)
+            lat.append(time.monotonic() - ts)
+            ok += 1
+        except Exception:
+            failed += 1
+    wall_s = time.perf_counter() - t0
+    deadline = time.monotonic() + 60
+    while (
+        sh._needs_rebuild
+        or not all(sl.is_serving() for sl in sh.slices)
+    ) and time.monotonic() < deadline:
+        time.sleep(0.02)
+    availability = ok / nqueries
+    post_retraces = sh.retraces_since(mark)
+    exact_after = _bit_exact()
+
+    # -- two-phase writes through the server, then whole-service
+    #    recovery reassembles the identical COO --------------------------
+    present = set(zip(rows.tolist(), cols.tolist()))
+    pool = rng.permutation(n).tolist()
+    pairs = []
+    for a, b in zip(pool[0::2], pool[1::2]):
+        if a != b and (a, b) not in present and (b, a) not in present:
+            pairs.append((int(a), int(b)))
+        if len(pairs) >= nwrites:
+            break
+    acked = 0
+    seq = 0
+    for a, b in pairs:
+        f = srv.submit_update([("insert", a, b), ("insert", b, a)])
+        srv.pump_updates(force=True)
+        f.result(timeout=120)
+        acked += 1
+        eng.swap(eng.apply_delta(DeltaBatch.from_ops(
+            [("insert", a, b, 1.0), ("insert", b, a, 1.0)],
+            start_seq=seq,
+        )))
+        seq += 2
+    frontier = list(sh.version.frontier)
+    coo_live = sh.to_host_coo()
+    sh.stop_supervisor()
+    srv.close()
+    sh.close()
+    t0 = time.perf_counter()
+    sh2 = ShardedEngine.recover(home, mode=mode)
+    recover_s = time.perf_counter() - t0
+    coo_rec = sh2.to_host_coo()
+    recovered_equal = all(
+        (x is None and y is None)
+        or np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(coo_live, coo_rec)
+    )
+    er, ec, _ev = eng.version.E.to_host_coo()
+    order = np.argsort(
+        np.asarray(er, np.int64) * n + np.asarray(ec, np.int64),
+        kind="stable",
+    )
+    writes_match_unsharded = np.array_equal(
+        np.asarray(er)[order], coo_rec[0]
+    ) and np.array_equal(np.asarray(ec)[order], coo_rec[1])
+    sh2.close()
+
+    out = {
+        "metric": "serve_shard_availability",
+        "warning": "closed-loop (coordinated omission)",
+        "unit": "fraction_ok",
+        "value": round(availability, 4),
+        "availability_pct": round(100 * availability, 2),
+        "ok": bool(
+            availability >= 0.99
+            and bytes_ratio <= 0.60
+            and exact_before
+            and exact_after
+            and post_retraces == 0
+            and sh.replacements >= 1
+            and acked == len(pairs)
+            and recovered_equal
+            and writes_match_unsharded
+        ),
+        "mode": mode,
+        "slices": nslices,
+        "nqueries": nqueries,
+        "reads_ok": ok,
+        "reads_failed": failed,
+        "bit_exact_before_kill": exact_before,
+        "bit_exact_after_respawn": exact_after,
+        "post_warmup_retraces": post_retraces,
+        "slice_deaths": sh.replacements,
+        "replacements": sh.replacements,
+        "device_bytes_unsharded": unsharded_bytes,
+        "device_bytes_per_slice": per_slice,
+        "per_slice_bytes_ratio": round(bytes_ratio, 4),
+        "writes_acked": acked,
+        "write_frontier": frontier,
+        "recovered_coo_equal": recovered_equal,
+        "writes_match_unsharded": writes_match_unsharded,
+        "p50_ms": round(1e3 * _percentile(lat, 0.50), 2) if lat else None,
+        "p99_ms": round(1e3 * _percentile(lat, 0.99), 2) if lat else None,
+        "qps_under_kill": round(nqueries / wall_s, 2),
+        "boot_s": round(boot_s, 2),
+        "recover_s": round(recover_s, 2),
+        "nnz": int(len(rows)),
+        "scale": scale,
+        "kinds": list(kinds),
+        "cpus": os.cpu_count(),
+        "home": home,
+    }
+    obs.gauge("serve.bench.shard_availability", availability)
+    if sidecar:
+        try:
+            out["obs_jsonl"] = obs.dump_jsonl()
+        except Exception as e:  # telemetry must never fail the bench
+            out["obs_error"] = str(e)
+    return out
+
+
 def _emit_pool_summary(out: dict) -> int:
     """The bench headline contract (bench.py ``emit_summary``) for the
     standalone pool scenario: a compact truncation-proof final stdout
@@ -1506,6 +1725,14 @@ def main():
             # the exit code 0 — the parent parses the last line and
             # derives rc itself; a nonzero child exit would discard
             # the whole per-tenant payload as a "child crash".
+            sys.exit(_emit_pool_summary(out))
+        return
+    if os.environ.get("BENCH_SERVE_SHARD") == "1":
+        out = run_shard()
+        print(json.dumps(out), flush=True)
+        if os.environ.get("BENCH_EMIT_SUMMARY", "1") != "0":
+            # standalone contract (see the pool branch): summary line
+            # + BENCH_SUMMARY.json, gate failures as the exit code
             sys.exit(_emit_pool_summary(out))
         return
     if os.environ.get("BENCH_SERVE_CHAOS") == "1":

@@ -401,7 +401,7 @@ def recover(dirpath: str, grid, *, kinds: tuple | None = None,
 
 def recover_version(checkpoint_dir: str, wal: WriteAheadLog | None,
                     grid, *, kinds: tuple | None = None,
-                    combine: str | None = None):
+                    combine: str | None = None, batch_filter=None):
     """Crash recovery: latest valid snapshot + WAL-suffix replay.
 
     Loads the newest loadable snapshot in ``checkpoint_dir`` (a corrupt
@@ -420,6 +420,15 @@ def recover_version(checkpoint_dir: str, wal: WriteAheadLog | None,
     is loadable.  ``kinds`` gates the same structural checks the
     engine's own merges run; ``combine`` is the upsert monoid (the
     buffer's ``min`` default).
+
+    ``batch_filter`` (sharded recovery, round 20): a callable mapping
+    each replayed :class:`DeltaBatch` to the sub-batch THIS store
+    actually owns (e.g. a row slab, translated to slab coordinates) or
+    ``None`` when nothing in the batch lands here.  The frontier stamp
+    still advances for filtered-out batches — a slice's ``wal_seq``
+    means "every acknowledged write through here is REFLECTED", which
+    for a foreign-row batch is vacuously true; skipping the stamp
+    would force an eternal no-op replay of the same records.
     """
     from ..utils import checkpoint as ckpt
     from . import merge as dyn_merge
@@ -430,12 +439,16 @@ def recover_version(checkpoint_dir: str, wal: WriteAheadLog | None,
     batches = replayed_ops = 0
     if wal is not None:
         for batch in wal.replay(after_seq=version.wal_seq):
-            version = dyn_merge.apply_delta(
-                version, batch, kinds=kinds, combine=combine,
-            )
-            version.wal_seq = batch.last_seq
-            batches += 1
-            replayed_ops += len(batch)
+            last_seq = batch.last_seq
+            if batch_filter is not None:
+                batch = batch_filter(batch)
+            if batch is not None and len(batch):
+                version = dyn_merge.apply_delta(
+                    version, batch, kinds=kinds, combine=combine,
+                )
+                batches += 1
+                replayed_ops += len(batch)
+            version.wal_seq = last_seq
     obs.count("serve.recovery.replayed_ops", replayed_ops)
     obs.observe("serve.recovery.recover_s", time.perf_counter() - t0)
     obs.count("serve.recovery.runs")

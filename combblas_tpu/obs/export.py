@@ -208,6 +208,8 @@ class ScrapeServer:
                 self.wfile.write(body)
 
         self.owner = owner
+        self._stopped = False
+        self._stop_lock = threading.Lock()
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self._httpd.daemon_threads = True
         self.host, self.port = self._httpd.server_address[:2]
@@ -229,6 +231,14 @@ class ScrapeServer:
         return f"http://{self.host}:{self.port}"
 
     def stop(self) -> None:
+        """Idempotent: repeated stops (owner.close() called twice, or a
+        detach racing a close-path teardown) must not shutdown() an
+        already-closed ThreadingHTTPServer — that call blocks forever
+        waiting for a serve_forever loop that already exited."""
+        with self._stop_lock:
+            if self._stopped:
+                return
+            self._stopped = True
         self._httpd.shutdown()
         self._httpd.server_close()
         self._thread.join(5.0)
@@ -240,23 +250,40 @@ def serve_scrape(owner=None, port: int = 0, host: str = "127.0.0.1"
     return ScrapeServer(owner, host=host, port=port)
 
 
+#: Serializes owner-registration (attach/detach) across threads: the
+#: old check-then-set on ``owner._scrape`` let two concurrent
+#: ``serve_metrics()`` calls (a Server and the FleetRouter wrapping
+#: it, or two API callers) BOTH start HTTP daemons — the loser's
+#: server leaked its port and thread forever (round 20 bugfix).
+_ATTACH_LOCK = threading.Lock()
+
+
 def attach_scrape(owner, port: int = 0, host: str = "127.0.0.1"
                   ) -> int:
     """The ONE serve_metrics implementation behind ``Server`` /
-    ``PoolServer`` / ``FleetRouter``: idempotently attach a scrape
-    thread to ``owner._scrape`` and return the bound port."""
-    if getattr(owner, "_scrape", None) is None:
-        owner._scrape = serve_scrape(owner, port=port, host=host)
-    return owner._scrape.port
+    ``PoolServer`` / ``FleetRouter`` / ``ProcessFleet``: idempotently
+    attach a scrape thread to ``owner._scrape`` and return the bound
+    port.  Safe to call repeatedly and concurrently; repeated
+    attach/close cycles re-attach a FRESH server each time (the
+    previous one was stopped and cleared by ``detach_scrape``)."""
+    with _ATTACH_LOCK:
+        s = getattr(owner, "_scrape", None)
+        if s is None or getattr(s, "_stopped", False):
+            owner._scrape = serve_scrape(owner, port=port, host=host)
+        return owner._scrape.port
 
 
 def detach_scrape(owner) -> None:
     """Stop and clear an attached scrape thread (close()-path twin of
-    ``attach_scrape``; no-op when never attached)."""
-    s = getattr(owner, "_scrape", None)
+    ``attach_scrape``; no-op when never attached, idempotent when
+    called twice).  The registration flip happens under the attach
+    lock; the (blocking) HTTP shutdown happens outside it, so a slow
+    teardown can never wedge a concurrent attach on another owner."""
+    with _ATTACH_LOCK:
+        s = getattr(owner, "_scrape", None)
+        owner._scrape = None
     if s is not None:
         s.stop()
-        owner._scrape = None
 
 
 # -- one-shot snapshot CLI ---------------------------------------------------

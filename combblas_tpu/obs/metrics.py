@@ -962,6 +962,84 @@ transports):
                                                      reply, not a
                                                      stranded future)
 ========================================  =========  ==================
+
+Sharded serving (round 20, serve/shard.py + serve/_shardworker.py —
+one graph partitioned over N slice processes, served as one engine;
+slice-side series carry a ``slice=`` label):
+
+========================================  =========  ==================
+``serve.shard.slices``                    gauge      live slice count
+                                                     (0 after close)
+``serve.shard.batch``                     histogram  router wall per
+                                                     batch (span;
+                                                     labels ``kind``,
+                                                     ``width``)
+``serve.shard.hops``                      counter    bulk-synchronous
+                                                     hop rounds fanned
+                                                     to every slice
+``serve.shard.hop_s``                     histogram  slice-side wall of
+                                                     one hop program
+``serve.shard.exec_retries``              counter    whole-batch
+                                                     replays after a
+                                                     mid-batch slice
+                                                     death + heal
+``serve.shard.writes``                    counter    two-phase write
+                                                     batches committed
+                                                     on every slice
+``serve.shard.write_aborts``              counter    phase-1 append
+                                                     failures (batch
+                                                     tombstoned, write
+                                                     rejected)
+``serve.shard.wal_appends`` /             counter    per-slice phase-1
+``serve.shard.wal_aborts``                           appends / abort
+                                                     tombstones
+``serve.shard.commits``                   counter    per-slice phase-2
+                                                     applies (frontier
+                                                     advances)
+``serve.shard.merge_s``                   histogram  slice-side slab
+                                                     merge latency
+``serve.shard.frontier_min``              gauge      vector frontier
+                                                     minimum (the
+                                                     scalar wal_seq
+                                                     projection)
+``serve.shard.frontier_lag``              gauge      max-min frontier
+                                                     spread right after
+                                                     a commit round
+``serve.shard.checkpoints``               counter    slab snapshots
+                                                     (labels ``reason``)
+``serve.shard.checkpoint_failed``         counter    failed slab
+                                                     auto-snapshots
+                                                     (previous snapshot
+                                                     + WAL intact)
+``serve.shard.recoveries``                counter    slice boots via
+                                                     slab snapshot +
+                                                     filtered WAL-
+                                                     suffix replay
+``serve.shard.slice_deaths``              counter    slices quarantined
+                                                     (dead / hung /
+                                                     failed RPC)
+``serve.shard.replacements``              counter    successful slice
+                                                     respawns
+``serve.shard.respawn_failed``            counter    respawn attempts
+                                                     that failed (next
+                                                     try after capped
+                                                     backoff)
+``serve.shard.heal_wait_s``               histogram  wall spent driving
+                                                     supervision until
+                                                     all slices serve
+``serve.shard.supervisor_errors``         counter    supervisor-loop
+                                                     tick exceptions
+``serve.shard.hb_snapshots``              counter    slice-worker
+                                                     heartbeat metric
+                                                     snapshots
+                                                     federated to the
+                                                     router
+``trace.serve.shard``                     counter    slice hop-program
+                                                     (re)traces (labels
+                                                     ``kind``,
+                                                     ``width``,
+                                                     ``slice``)
+========================================  =========  ==================
 """
 
 from __future__ import annotations

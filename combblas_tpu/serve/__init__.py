@@ -73,6 +73,18 @@ batches". Four layers (docs/serving.md has the full architecture):
    Poisson load harness (``net/loadgen.py``, ``BENCH_SERVE_NET=1``)
    whose latencies are measured from scheduled arrival time — no
    coordinated omission.
+10. **shard** (`shard.py` + `_shardworker.py`, round 20) —
+   ``ShardedEngine``: ONE huge graph partitioned over N slice
+   processes (contiguous row slabs, each a rectangular EllParMat on
+   its own JAX runtime — per-host resident bytes ~1/p), duck-typing
+   ``GraphEngine`` so the batcher/scheduler/api/net stack above runs
+   UNCHANGED on top.  Queries execute as router-driven
+   bulk-synchronous hop loops (the same jitted step bodies as the
+   unsharded while_loop — bfs/sssp answers bit-exact); writes run a
+   two-phase per-slice WAL protocol under a VECTOR checkpoint
+   frontier; a dead slice is quarantined, respawned from its slab
+   snapshot + WAL suffix, and re-joined while the OTHER slices keep
+   serving (docs/serving.md "Sharded serving").
 
 Everything is wired into ``combblas_tpu.obs`` (queue-depth gauge,
 occupancy/padding-waste/latency histograms, plan-cache and
@@ -101,6 +113,7 @@ from .pool import EnginePool, PoolServer
 from .fleet import FleetRouter, ReplicaDeadError
 from .procfleet import IpcTimeoutError, ProcessFleet, ReplicaProc
 from .net import NetClient, NetFrontend
+from .shard import ShardedEngine, ShardedGraphVersion, plan_partition
 from .slo import ErrorBudget
 
 __all__ = [
@@ -110,6 +123,7 @@ __all__ = [
     "ReplicaDeadError",
     "ProcessFleet", "ReplicaProc", "IpcTimeoutError",
     "NetFrontend", "NetClient",
+    "ShardedEngine", "ShardedGraphVersion", "plan_partition",
     "FaultInjector", "InjectedFault", "ProcessFaultPlan",
     "FAULT_POINTS", "ErrorBudget",
     "Request", "KINDS",

@@ -314,7 +314,9 @@ class Server:
             # attach_durability)
             start_seq=(
                 self._wal_frontier + 1
-                if self._wal is not None else 0
+                if (self._wal is not None
+                    or getattr(self.engine, "owns_durability", False))
+                else 0
             ),
         )
 
@@ -373,6 +375,23 @@ class Server:
             d = tuner_config.wal_dir(self.config.wal_dir)
         else:
             d = os.path.abspath(d)  # idempotence compares abspaths
+        if getattr(self.engine, "owns_durability", False):
+            # engine-owned durability (round 20, the sharded engine):
+            # writes are logged PER-SLICE inside the engine's own
+            # two-phase protocol — a server-level scalar WAL stacked
+            # on top would double-log every write on a second lineage
+            # and re-apply it at recovery.  The seqno frontier still
+            # seeds from the engine's (vector-min) stamp so the delta
+            # buffer continues the shared sequence line.
+            if d is not None:
+                raise ValueError(
+                    f"wal_dir {d!r} configured, but the engine owns "
+                    "its own durability (per-slice WALs); remove "
+                    "wal_dir / COMBBLAS_WAL for sharded serving"
+                )
+            self._wal_frontier = int(self.engine.version.wal_seq)
+            self._wal_applied = self._wal_frontier
+            return
         if d is None:
             return
         if self.engine.version.host_coo is None:
@@ -435,7 +454,9 @@ class Server:
 
     @property
     def durable(self) -> bool:
-        return self._wal is not None
+        return self._wal is not None or getattr(
+            self.engine, "owns_durability", False
+        )
 
     def checkpoint_now(self, reason: str = "manual",
                        _raise: bool = False) -> dict | None:
@@ -450,6 +471,16 @@ class Server:
         import os
 
         if self._ckpt_dir is None:
+            if getattr(self.engine, "owns_durability", False):
+                # delegate: the sharded engine snapshots every slice
+                # at its own frontier and re-writes the manifest
+                try:
+                    return self.engine.checkpoint_now(reason=reason)
+                except Exception:
+                    self.checkpoint_failures += 1
+                    if _raise:
+                        raise
+                    return None
             return None
         from ..tuner import config as tuner_config
         from ..utils import checkpoint as ckpt
@@ -632,7 +663,10 @@ class Server:
             raise RuntimeError(
                 "serve.Server is closed; no further admissions"
             )
-        if self.engine.version.host_coo is None:
+        if not getattr(
+            self.engine, "supports_updates",
+            self.engine.version.host_coo is not None,
+        ):
             raise ValueError(
                 "the mutation lane needs the host edge list: build "
                 "the engine with GraphEngine.from_coo(keep_coo=True)"

@@ -264,7 +264,7 @@ def load_latest_version(dirpath: str, grid, *, writable: bool = True):
     )
 
 
-def save_version(path: str, version) -> None:
+def save_version(path: str, version, *, extra_meta: dict | None = None) -> None:
     """Snapshot a serve ``GraphVersion`` to one self-describing .npz —
     the warm-start half of the replicated fleet (docs/serving.md
     "Multi-tenant pool & fleet").
@@ -286,6 +286,13 @@ def save_version(path: str, version) -> None:
     one under the real name — and the version's WAL position
     (``version.wal_seq``) is stamped into the meta: recovery replays
     exactly the log suffix this snapshot does not already contain.
+
+    ``extra_meta`` (round 20, sharded serving): an arbitrary
+    JSON-able dict stored under ``meta["extra"]`` and surfaced as
+    ``version.extra_meta`` on load — slab snapshots use it to be
+    SELF-DESCRIBING (``{"shard": {idx, row0, row1, ...}}``), so
+    slice recovery needs only the slice's home directory, never the
+    service manifest.
     """
     import time
 
@@ -304,6 +311,8 @@ def save_version(path: str, version) -> None:
         "grid": [version.E.grid.pr, version.E.grid.pc],
         "mats": {},
     }
+    if extra_meta is not None:
+        meta["extra"] = extra_meta
     arrays: dict = {
         "deg": np.asarray(version.deg),
     }
@@ -487,6 +496,9 @@ def _load_version(path: str, grid: Grid, writable: bool = True):
             headroom=meta["headroom"],
             wal_seq=int(meta.get("wal_seq", -1)),
         )
+        # self-description channel (round 20): slab snapshots carry a
+        # shard descriptor here; absent for whole-graph snapshots
+        version.extra_meta = meta.get("extra")
         if host_coo is not None and writable:
             # round 16: the merge state must describe the RESTORED
             # bucket layout, sticky slots included — a later

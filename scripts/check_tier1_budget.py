@@ -1,0 +1,137 @@
+#!/usr/bin/env python
+"""Tier-1 runtime budget guard (round 20).
+
+The tier-1 suite runs under a hard ``timeout -k 10 870`` (ROADMAP.md).
+The suite's measured wall has crept to within ~20 s of that ceiling —
+a PR that quietly adds a 30-second "fast" test turns the whole gate
+red by TIMEOUT, which reads as flakiness instead of what it is: a
+budget overrun.  This guard makes the overrun loud and attributable
+BEFORE the timeout does it silently:
+
+    python -m pytest tests/ -q -m 'not slow' --durations=50 \
+        2>&1 | tee /tmp/t1.log
+    python scripts/check_tier1_budget.py /tmp/t1.log --budget 860
+
+It parses the pytest summary wall clock (``... in 843.21s``) and the
+``--durations`` table, projects the tier-1 wall (optionally
+subtracting tests listed in ``--slow-ids`` — e.g. when the log came
+from a full run that included slow-marked tests), and exits non-zero
+when the projection exceeds the budget, naming the top offenders so
+the fix (gate the test ``slow``, or shrink it) is obvious.
+
+Exit codes: 0 within budget, 1 over budget, 2 unparseable log.
+"""
+
+from __future__ import annotations
+
+import argparse
+import re
+import sys
+
+#: pytest summary wall clock: "12 passed, 3 deselected in 843.21s" /
+#: "2 failed, 10 passed in 91.02s (0:01:31)".
+_WALL_RE = re.compile(
+    r"\b(?:passed|failed|error(?:s)?|skipped|deselected|no tests ran)"
+    r"\b.* in (\d+(?:\.\d+)?)s"
+)
+
+#: one ``--durations`` table row: "12.34s call     tests/x.py::test_y"
+_DURATION_RE = re.compile(
+    r"^\s*(\d+(?:\.\d+)?)s\s+(call|setup|teardown)\s+(\S+)\s*$"
+)
+
+
+def parse_log(text: str):
+    """-> (wall_seconds | None, [(seconds, phase, test_id), ...])"""
+    wall = None
+    rows = []
+    for line in text.splitlines():
+        m = _DURATION_RE.match(line)
+        if m:
+            rows.append((float(m.group(1)), m.group(2), m.group(3)))
+            continue
+        m = _WALL_RE.search(line)
+        if m:
+            wall = float(m.group(1))  # last summary line wins
+    return wall, rows
+
+
+def project(wall: float, rows, slow_ids=()):
+    """Projected tier-1 wall: the measured wall minus every recorded
+    duration (all phases) of tests in ``slow_ids``.  Durations not in
+    the table (pytest hides the sub-5 ms tail) stay inside ``wall`` —
+    the projection only ever errs HIGH, which is the safe direction
+    for a ceiling check."""
+    slow = set(slow_ids)
+    shaved = sum(s for s, _ph, tid in rows if tid in slow)
+    return wall - shaved, shaved
+
+
+def offenders(rows, slow_ids=(), top: int = 10):
+    """Biggest per-test call-phase costs among the tests that COUNT
+    toward the budget, worst first."""
+    slow = set(slow_ids)
+    per_test: dict = {}
+    for s, ph, tid in rows:
+        if tid in slow or ph != "call":
+            continue
+        per_test[tid] = per_test.get(tid, 0.0) + s
+    return sorted(per_test.items(), key=lambda kv: -kv[1])[:top]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Fail when the tier-1 suite's projected wall "
+        "clock exceeds the runtime budget."
+    )
+    ap.add_argument("log", help="pytest output (tee'd log file)")
+    ap.add_argument("--budget", type=float, default=860.0,
+                    help="wall-clock ceiling in seconds "
+                    "(default 860 — 10 s under the 870 s timeout)")
+    ap.add_argument("--slow-ids", metavar="FILE",
+                    help="file of test ids (one per line) to subtract "
+                    "from the projection (tests being slow-gated, or "
+                    "a log that included slow-marked tests)")
+    ap.add_argument("--top", type=int, default=10,
+                    help="offenders to name when over budget")
+    args = ap.parse_args(argv)
+
+    with open(args.log, errors="replace") as f:
+        text = f.read()
+    wall, rows = parse_log(text)
+    if wall is None:
+        print("check_tier1_budget: no pytest summary wall clock in "
+              f"{args.log} (did the run finish?)", file=sys.stderr)
+        return 2
+
+    slow_ids = []
+    if args.slow_ids:
+        with open(args.slow_ids) as f:
+            slow_ids = [
+                ln.strip() for ln in f
+                if ln.strip() and not ln.startswith("#")
+            ]
+    projected, shaved = project(wall, rows, slow_ids)
+    verdict = "OK" if projected <= args.budget else "OVER BUDGET"
+    print(f"tier-1 wall {wall:.1f}s"
+          + (f" - {shaved:.1f}s slow-gated" if shaved else "")
+          + f" = {projected:.1f}s projected vs {args.budget:.0f}s "
+          f"budget: {verdict}")
+    if projected <= args.budget:
+        return 0
+    print(f"over by {projected - args.budget:.1f}s; "
+          "top in-budget tests by call time:", file=sys.stderr)
+    worst = offenders(rows, slow_ids, top=args.top)
+    if not worst:
+        print("  (no --durations table in the log; re-run pytest "
+              "with --durations=50 to attribute the overrun)",
+              file=sys.stderr)
+    for tid, s in worst:
+        print(f"  {s:8.2f}s  {tid}", file=sys.stderr)
+    print("gate the biggest new tests with @pytest.mark.slow or "
+          "shrink them.", file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -544,3 +544,50 @@ def test_aggregate_merges_reservoir_quantiles(tmp_path):
     assert h["count"] == 4
     assert h["p50"] == pytest.approx(0.25)
     assert h["p99"] == pytest.approx(0.397)
+
+
+def test_scrape_attach_close_attach_cycle():
+    """Round-20 bugfix: repeated serve_metrics()/close() cycles on one
+    owner must attach a FRESH working server each time (the old code
+    returned the stopped server's dead port), stop() is idempotent
+    (a double shutdown() of ThreadingHTTPServer blocks forever), and
+    concurrent attaches collapse to one server."""
+    import threading
+    import types
+
+    obs.enable(install_hooks=False)
+    obs.count("serve.requests", 1, kind="bfs")
+    stub = types.SimpleNamespace()
+    p1 = obs_export.attach_scrape(stub)
+    s1 = stub._scrape
+    obs_export.detach_scrape(stub)
+    assert stub._scrape is None
+    s1.stop()  # second stop: must return, not block
+    # re-attach after close: a FRESH live server, not the dead one
+    p2 = obs_export.attach_scrape(stub)
+    assert stub._scrape is not s1 and not stub._scrape._stopped
+    text = urllib.request.urlopen(
+        f"http://127.0.0.1:{p2}/metrics", timeout=10
+    ).read().decode()
+    assert "combblas_serve_requests" in text
+    # an owner whose scrape was stopped WITHOUT detach (a close path
+    # that bypassed detach_scrape) also re-attaches fresh
+    stub._scrape.stop()
+    p3 = obs_export.attach_scrape(stub)
+    assert not stub._scrape._stopped
+    # concurrent attaches: one server, one port
+    obs_export.detach_scrape(stub)
+    ports = []
+
+    def attach():
+        ports.append(obs_export.attach_scrape(stub))
+
+    threads = [threading.Thread(target=attach) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(set(ports)) == 1
+    obs_export.detach_scrape(stub)
+    obs_export.detach_scrape(stub)  # idempotent no-op
+    assert stub._scrape is None

@@ -587,3 +587,113 @@ def test_open_loop_under_sigkill_chaos():
     assert out["untyped_failures"] == 0, out
     assert out["stranded_futures"] == 0
     assert out["availability"] >= 0.9, out
+
+
+# --- blocking-client retry policy (round 20) --------------------------------
+
+
+def test_client_retry_policy_unit():
+    """The ``_call_retrying`` contract, driven with stub send
+    functions (no sockets): backpressure sleeps the server's hint and
+    resends until the budget runs out; a send failure (the request
+    never left this process) reconnects and resends EVEN for writes;
+    an in-flight death resends reads but surfaces to write callers
+    (``retry_inflight=False`` — idempotency is theirs)."""
+    from concurrent.futures import Future
+
+    cli = NetClient.__new__(NetClient)
+    cli.max_retries = 3
+    cli.backoff_s = 0.001
+    cli.max_backoff_s = 0.004
+    cli._closed = False
+    reconnects = []
+    cli._ensure_connected = lambda: reconnects.append(1)
+
+    def failing(exc, fails, then=None):
+        state = {"n": 0}
+
+        def send():
+            state["n"] += 1
+            fut = Future()
+            if state["n"] <= fails:
+                fut.set_exception(exc)
+            else:
+                fut.set_result(then)
+            return fut
+
+        return send
+
+    # backpressure: two rejects, then success — inside the budget
+    bp = BackpressureError(7, 0.001)
+    assert cli._call_retrying(failing(bp, 2, {"ok": 1}), 5.0) == {
+        "ok": 1
+    }
+    # budget exhaustion surfaces the typed error
+    with pytest.raises(BackpressureError):
+        cli._call_retrying(failing(bp, 99), 5.0)
+    # the breaker subclass rides the same lane (its retry_after_s is
+    # the cooldown hint)
+    brk = CircuitBreakerOpen("bfs", 0.001)
+    assert cli._call_retrying(failing(brk, 1, {"ok": 2}), 5.0) == {
+        "ok": 2
+    }
+    # send failure: never left the process — writes resend too
+    state = {"n": 0}
+
+    def send_fail_then_ok():
+        state["n"] += 1
+        if state["n"] == 1:
+            raise ConnectionError("send failed")
+        fut = Future()
+        fut.set_result({"ok": 3})
+        return fut
+
+    assert cli._call_retrying(
+        send_fail_then_ok, 5.0, retry_inflight=False
+    ) == {"ok": 3}
+    assert reconnects  # the drop triggered a reconnect
+    # in-flight death: reads resend...
+    gone = ConnectionError("server gone")
+    assert cli._call_retrying(failing(gone, 1, {"ok": 4}), 5.0) == {
+        "ok": 4
+    }
+    # ...writes do not (may have been applied server-side)
+    with pytest.raises(ConnectionError):
+        cli._call_retrying(failing(gone, 1, {"ok": 5}), 5.0,
+                           retry_inflight=False)
+    # a closed client never retries
+    cli._closed = True
+    with pytest.raises(ConnectionError):
+        cli._call_retrying(failing(gone, 1, {"ok": 6}), 5.0)
+    # max_retries=0 restores fail-fast
+    cli._closed = False
+    cli.max_retries = 0
+    with pytest.raises(BackpressureError):
+        cli._call_retrying(failing(bp, 1, {"ok": 7}), 5.0)
+
+
+def test_client_reconnects_after_connection_drop(served, live_roots):
+    """E2E over a real socket: the connection dies under the client
+    (channel torn down mid-session); the next blocking submit
+    reconnects — new socket, new hello, new reader generation — and
+    answers bit-exactly.  The nowait primitives stay fail-fast."""
+    srv, fe = served
+    root = int(live_roots[0])
+    direct = srv.submit("bfs", root).result(timeout=60)
+    cli = NetClient("127.0.0.1", fe.port)
+    try:
+        np.testing.assert_array_equal(
+            cli.submit("bfs", root)["levels"], direct["levels"]
+        )
+        cli.ch.close()  # the drop: every send on this channel fails
+        out = cli.submit("bfs", root)
+        np.testing.assert_array_equal(out["levels"], direct["levels"])
+        assert cli.reconnects >= 1
+        assert cli.pending == 0  # no stranded futures across the drop
+        # nowait on a freshly-dropped channel surfaces the error
+        cli.ch.close()
+        with pytest.raises(ConnectionError):
+            cli.submit_nowait("bfs", root)
+        cli.submit("bfs", root)  # the blocking lane still self-heals
+    finally:
+        cli.close()

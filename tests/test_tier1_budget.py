@@ -1,0 +1,119 @@
+"""The tier-1 runtime budget guard (round 20 satellite): parsing the
+pytest summary + ``--durations`` table, the slow-id subtraction, and
+the CLI's exit-code contract over synthetic logs."""
+
+import importlib.util
+import os
+import sys
+
+import pytest
+
+_SCRIPT = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "scripts", "check_tier1_budget.py",
+)
+
+
+@pytest.fixture(scope="module")
+def guard():
+    spec = importlib.util.spec_from_file_location(
+        "check_tier1_budget", _SCRIPT
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+_LOG = """\
+............                                                       [100%]
+============================= slowest 5 durations ==========================
+40.00s call     tests/test_big.py::test_heavy
+12.50s call     tests/test_mid.py::test_medium
+5.00s setup    tests/test_big.py::test_heavy
+0.40s call     tests/test_small.py::test_tiny
+
+(2 durations < 0.005s hidden.  Use -vv to show these durations.)
+830 passed, 22 deselected in 843.21s (0:14:03)
+"""
+
+
+def test_parse_wall_and_durations(guard):
+    wall, rows = guard.parse_log(_LOG)
+    assert wall == 843.21
+    assert (40.0, "call", "tests/test_big.py::test_heavy") in rows
+    assert (5.0, "setup", "tests/test_big.py::test_heavy") in rows
+    assert len(rows) == 4
+    # a failing run's summary parses too, last summary line wins
+    wall, _ = guard.parse_log(
+        "x\n2 failed, 10 passed in 91.02s (0:01:31)\n"
+        "1 failed in 12.00s\n"
+    )
+    assert wall == 12.0
+    assert guard.parse_log("no summary here")[0] is None
+
+
+def test_projection_subtracts_slow_ids_all_phases(guard):
+    wall, rows = guard.parse_log(_LOG)
+    projected, shaved = guard.project(
+        wall, rows, ["tests/test_big.py::test_heavy"]
+    )
+    assert shaved == 45.0  # call AND setup phases
+    assert projected == pytest.approx(843.21 - 45.0)
+    # no slow ids: projection is the measured wall
+    assert guard.project(wall, rows)[0] == wall
+
+
+def test_offenders_rank_in_budget_call_time_only(guard):
+    _, rows = guard.parse_log(_LOG)
+    worst = guard.offenders(rows,
+                            ["tests/test_big.py::test_heavy"], top=5)
+    assert worst[0] == ("tests/test_mid.py::test_medium", 12.5)
+    assert all(tid != "tests/test_big.py::test_heavy"
+               for tid, _ in worst)
+
+
+def _run(guard, tmp_path, log_text, *argv):
+    log = tmp_path / "t1.log"
+    log.write_text(log_text)
+    return guard.main([str(log), *argv])
+
+
+def test_cli_within_budget_exits_zero(guard, tmp_path, capsys):
+    assert _run(guard, tmp_path, _LOG, "--budget", "860") == 0
+    assert "OK" in capsys.readouterr().out
+
+
+def test_cli_over_budget_names_offenders(guard, tmp_path, capsys):
+    assert _run(guard, tmp_path, _LOG, "--budget", "800") == 1
+    cap = capsys.readouterr()
+    assert "OVER BUDGET" in cap.out
+    assert "tests/test_big.py::test_heavy" in cap.err
+    assert "mark.slow" in cap.err
+
+
+def test_cli_slow_ids_file_rescues_budget(guard, tmp_path, capsys):
+    ids = tmp_path / "slow.txt"
+    ids.write_text("# gated in this PR\n"
+                   "tests/test_big.py::test_heavy\n\n")
+    assert _run(guard, tmp_path, _LOG, "--budget", "800",
+                "--slow-ids", str(ids)) == 0
+    assert "45.0s slow-gated" in capsys.readouterr().out
+
+
+def test_cli_unparseable_log_exits_two(guard, tmp_path, capsys):
+    assert _run(guard, tmp_path, "garbage\nnothing useful\n") == 2
+    assert "no pytest summary" in capsys.readouterr().err
+
+
+def test_cli_entrypoint_runs(tmp_path):
+    """The script works as a subprocess CLI (the CI invocation)."""
+    import subprocess
+
+    log = tmp_path / "t1.log"
+    log.write_text(_LOG)
+    p = subprocess.run(
+        [sys.executable, _SCRIPT, str(log), "--budget", "860"],
+        capture_output=True, text=True,
+    )
+    assert p.returncode == 0, p.stderr
+    assert "OK" in p.stdout
