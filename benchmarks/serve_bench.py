@@ -1546,6 +1546,54 @@ def run_shard(scale: int = SCALE, edgefactor: int = EDGEFACTOR) -> dict:
 
     exact_before = _bit_exact()
 
+    # -- round-21 wire-protocol A/B: the same engine answers the probe
+    #    batch under forced dense, forced sparse, then auto encoding.
+    #    Hop payload (bytes_by_enc over the frontier fans only — the
+    #    collect/final fetch is identical across modes) is the gated
+    #    quantity: sparse must ship <= 0.20x the dense bytes without
+    #    giving back more than 5% hop wall. Five INTERLEAVED rounds
+    #    (each round runs all three modes back to back, so scheduler /
+    #    allocator drift on a single-CPU runner lands on every mode
+    #    equally); bytes are deterministic, wall takes the per-mode
+    #    min to shrug off one-sided multi-second GC outliers.
+    modes = ("dense", "sparse", "auto")
+    walls: dict = {m: [] for m in modes}
+    stats: dict = {}
+    saved_mode = sh.frontier_mode
+    for _ in range(5):
+        for fmode in modes:
+            sh.frontier_mode = fmode
+            sh.execute("bfs", probe)
+            walls[fmode].append(sh.last_exec_stats["hop_wall_s"])
+            stats[fmode] = sh.last_exec_stats
+    sh.frontier_mode = saved_mode
+    enc_ab: dict = {}
+    for fmode in modes:
+        st = stats[fmode]
+        hop_payload = sum(
+            v for k, v in st["bytes_by_enc"].items()
+            if k in ("sparse", "dense")
+        )
+        best = min(walls[fmode])
+        enc_ab[fmode] = {
+            "hops": st["hops"],
+            "hop_payload_bytes": int(hop_payload),
+            "bytes_out": int(st["bytes_out"]),
+            "bytes_in": int(st["bytes_in"]),
+            "enc_hops": dict(st["enc_hops"]),
+            "frontier_nnz": [int(z) for z in st["frontier_nnz"]],
+            "hop_wall_s": round(best, 5),
+            "hop_ms_mean": round(1e3 * best / max(st["hops"], 1), 3),
+        }
+    wire_ratio = (
+        enc_ab["sparse"]["hop_payload_bytes"]
+        / max(enc_ab["dense"]["hop_payload_bytes"], 1)
+    )
+    hop_wall_ratio = (
+        enc_ab["sparse"]["hop_wall_s"]
+        / max(enc_ab["dense"]["hop_wall_s"], 1e-9)
+    )
+
     # -- closed-loop stream through the batcher, one slice SIGKILLed
     #    mid-stream while the supervisor heals it ------------------------
     mark = sh.trace_mark()
@@ -1644,7 +1692,15 @@ def run_shard(scale: int = SCALE, edgefactor: int = EDGEFACTOR) -> dict:
             and acked == len(pairs)
             and recovered_equal
             and writes_match_unsharded
+            and wire_ratio <= 0.20
+            and hop_wall_ratio <= 1.05
         ),
+        "wire": {
+            "ratio": round(wire_ratio, 4),
+            "hop_wall_ratio": round(hop_wall_ratio, 4),
+            "frontier_mode": saved_mode,
+            "per_mode": enc_ab,
+        },
         "mode": mode,
         "slices": nslices,
         "nqueries": nqueries,
@@ -1696,6 +1752,10 @@ def _emit_pool_summary(out: dict) -> int:
         "rc": rc,
         "per_tenant": out.get("per_tenant"),
     }
+    if out.get("wire") is not None:
+        # shard scenario: per-hop wire-bytes + hop-latency breakdown
+        # rides the summary line so truncated logs still carry it
+        s["wire"] = out["wire"]
     path = os.environ.get("BENCH_SUMMARY_PATH", "BENCH_SUMMARY.json")
     try:
         with open(path, "w") as f:

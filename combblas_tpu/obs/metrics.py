@@ -1040,6 +1040,45 @@ slice-side series carry a ``slice=`` label):
                                                      ``width``,
                                                      ``slice``)
 ========================================  =========  ==================
+
+Sharded hop wire protocol (round 21, serve/shard.py — sparse frontier
+triples + slice-resident loop state; see docs/serving.md "Sharded hop
+wire protocol"):
+
+========================================  =========  ==================
+``serve.shard.hop_bytes``                 counter    logical payload
+                                                     bytes per fan
+                                                     (labels
+                                                     ``direction``
+                                                     out|in,
+                                                     ``encoding``
+                                                     sparse|dense|
+                                                     final|collect)
+``serve.shard.frontier_nnz``              histogram  router-side
+                                                     frontier entries
+                                                     per hop (label
+                                                     ``kind``)
+``serve.shard.encoding``                  counter    per-hop router
+                                                     encoding decision
+                                                     (label ``choice``
+                                                     sparse|dense;
+                                                     frontier hops
+                                                     only)
+``serve.shard.stale_epochs``              counter    healthy-slice
+                                                     resident-state
+                                                     misses that forced
+                                                     a whole-batch
+                                                     replay (label
+                                                     ``kind``)
+``serve.shard.wire_quant_err``            histogram  router-side max
+                                                     abs bf16
+                                                     quantization error
+                                                     per outbound dense
+                                                     payload (only
+                                                     under
+                                                     COMBBLAS_SHARD_
+                                                     WIRE=bf16)
+========================================  =========  ==================
 """
 
 from __future__ import annotations

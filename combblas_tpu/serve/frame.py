@@ -21,7 +21,11 @@ envelope in the header; :func:`decode` rebuilds them with
 ``np.frombuffer`` — a memcpy, not a float-parse.  That keeps a
 pagerank reply (one n-vector per query) at wire cost ~= its array
 bytes, which is what lets the serving read path stay exec-bound
-instead of serialization-bound.
+instead of serialization-bound.  Round 21 adds one typed envelope on
+top: :class:`SparseFrontier` rides as ``__spf__`` (dtype-minimized
+frontier triples — the sharded hop protocol's sparse wire encoding)
+and :func:`pack_bf16`/:func:`unpack_bf16` give dense payloads an
+opt-in half-width float codec with no dtype-string dependency.
 
 Big payloads (graph versions) still NEVER ride a channel: they travel
 as ``save_version`` checkpoint files on disk and the message carries
@@ -38,6 +42,7 @@ the frontend from the per-channel byte counters below.
 from __future__ import annotations
 
 import json
+import select
 import socket
 import struct
 import threading
@@ -50,6 +55,12 @@ from .. import obs
 #: Hard cap on one frame — a corrupt length prefix must not allocate
 #: gigabytes; real messages are query results (KBs).
 MAX_FRAME = 64 << 20
+# sender-side no-progress deadline (see Channel._send_frame): a peer
+# that drains NOTHING for this long is wedged, not slow.  Generous on
+# purpose — boot-sized frames to a child that is still importing its
+# JAX runtime on a loaded single-core box legitimately stall for tens
+# of seconds; liveness policing belongs to heartbeats, not the wire.
+SEND_TIMEOUT_S = 300.0
 
 
 class ChannelClosed(ConnectionError):
@@ -57,6 +68,74 @@ class ChannelClosed(ConnectionError):
     this is crash detection, handled by quarantine + respawn; for a
     net connection it is client disconnect, handled by connection
     cleanup (in-flight replies are dropped, never stranded)."""
+
+
+class SparseFrontier:
+    """Typed sparse-frontier wire payload (round 21): the live COO
+    triples of a logically-dense ``[n, width]`` hop operand.
+
+    The sharded hop protocol (``serve/shard.py``) ships O(frontier)
+    triples instead of the O(n*W) dense state — the CombBLAS SpMSpV
+    stance applied at the wire.  Encoded as a first-class ``__spf__``
+    header envelope so both sides get the TYPE back, not a bag of
+    arrays; dtypes are wire-minimized: rows ``int32``, lanes ``uint8``
+    (batch widths are <= 256 by serve-config construction), values
+    ``float32`` or absent entirely (a bfs frontier's values ARE its
+    row ids).
+    """
+
+    __slots__ = ("n", "width", "rows", "lanes", "vals")
+
+    def __init__(self, n: int, width: int, rows, lanes, vals=None):
+        self.n = int(n)
+        self.width = int(width)
+        if not (1 <= self.width <= 256):
+            raise ValueError(
+                f"SparseFrontier width must be in [1, 256] (lanes "
+                f"ride uint8); got {self.width}"
+            )
+        self.rows = np.ascontiguousarray(rows, np.int32)
+        self.lanes = np.ascontiguousarray(lanes, np.uint8)
+        self.vals = (None if vals is None
+                     else np.ascontiguousarray(vals, np.float32))
+
+    @property
+    def nnz(self) -> int:
+        return int(self.rows.shape[0])
+
+    def nbytes(self) -> int:
+        """Logical wire bytes of the triple arrays (the router's
+        hop-payload accounting surface)."""
+        t = self.rows.nbytes + self.lanes.nbytes
+        return t + (0 if self.vals is None else self.vals.nbytes)
+
+    def to_dense(self, fill, dtype=None) -> np.ndarray:
+        """Host-side scatter into the dense ``[n, width]`` array the
+        triples describe: ``fill`` everywhere, ``vals`` (or the row
+        ids when vals is None) at the triples."""
+        dt = np.dtype(dtype) if dtype is not None \
+            else np.asarray(fill).dtype
+        out = np.full((self.n, self.width), fill, dt)
+        out[self.rows, self.lanes.astype(np.int64)] = (
+            self.rows if self.vals is None else self.vals
+        )
+        return out
+
+
+def pack_bf16(a: np.ndarray) -> np.ndarray:
+    """float32 -> bf16-on-the-wire as raw uint16 (round-to-nearest-
+    even via the carry-in bias trick), dependency-free — no ml_dtypes
+    on the wire, so both peers agree on the codec by construction."""
+    u = np.ascontiguousarray(a, np.float32).view(np.uint32)
+    u = u + np.uint32(0x7FFF) + ((u >> np.uint32(16)) & np.uint32(1))
+    return (u >> np.uint32(16)).astype(np.uint16)
+
+
+def unpack_bf16(u: np.ndarray) -> np.ndarray:
+    """The decode half of :func:`pack_bf16`: uint16 -> float32 by
+    reinstating the truncated mantissa bits as zeros."""
+    w = np.ascontiguousarray(u, np.uint16).astype(np.uint32)
+    return (w << np.uint32(16)).view(np.float32)
 
 
 def _headerable(obj, blobs: list):
@@ -73,6 +152,14 @@ def _headerable(obj, blobs: list):
             "off": off,
             "nbytes": a.nbytes,
         }
+    if isinstance(obj, SparseFrontier):
+        return {"__spf__": {
+            "n": obj.n, "width": obj.width,
+            "rows": _headerable(obj.rows, blobs),
+            "lanes": _headerable(obj.lanes, blobs),
+            "vals": (None if obj.vals is None
+                     else _headerable(obj.vals, blobs)),
+        }}
     if isinstance(obj, (np.integer,)):
         return int(obj)
     if isinstance(obj, (np.floating,)):
@@ -116,6 +203,15 @@ def _denumpy(obj, binary):
                 binary[off:off + nb], dtype=np.dtype(obj["__ndb__"])
             ).reshape(obj["shape"]).copy()  # own the memory: the
             # frame buffer is released after decode
+        if "__spf__" in obj:
+            m = obj["__spf__"]
+            vals = m.get("vals")
+            return SparseFrontier(
+                int(m["n"]), int(m["width"]),
+                _denumpy(m["rows"], binary),
+                _denumpy(m["lanes"], binary),
+                None if vals is None else _denumpy(vals, binary),
+            )
         return {k: _denumpy(v, binary) for k, v in obj.items()}
     if isinstance(obj, list):
         return [_denumpy(v, binary) for v in obj]
@@ -184,11 +280,41 @@ class Channel:
             if self._closed:
                 raise ChannelClosed("channel closed")
             try:
-                self._sock.sendall(frame)
+                self._send_frame(frame)
             except (OSError, ValueError) as e:
                 raise ChannelClosed(f"peer gone: {e}") from e
             self.bytes_out += len(frame)
         return len(frame)
+
+    def _send_frame(self, frame: bytes) -> None:
+        # NOT ``sendall``: ``settimeout`` is socket-GLOBAL, so a
+        # concurrent reader polling ``recv`` with a short tick would
+        # impose that tick on the whole sendall — and any frame larger
+        # than the kernel socket buffer headed to a busy peer (a boot
+        # payload to a child still importing its runtime, a dense hop
+        # slab mid-compile) would spuriously "time out".  Chunked
+        # select+send keeps partial progress across ticks and only
+        # gives up after SEND_TIMEOUT_S of ZERO forward progress — a
+        # genuinely wedged peer, not a slow one.
+        view = memoryview(frame)
+        stalled_since = time.monotonic()
+        while view:
+            _, writable, _ = select.select([], [self._sock], [], 1.0)
+            n = 0
+            if writable:
+                try:
+                    n = self._sock.send(view)
+                except (socket.timeout, BlockingIOError,
+                        InterruptedError):
+                    n = 0
+            if n:
+                view = view[n:]
+                stalled_since = time.monotonic()
+            elif time.monotonic() - stalled_since > SEND_TIMEOUT_S:
+                raise OSError(
+                    f"send stalled > {SEND_TIMEOUT_S:g}s "
+                    f"({len(view)} bytes undrained)"
+                )
 
     def recv(self, timeout: float | None = None) -> dict:
         """One message; ``socket.timeout`` when a whole frame has not

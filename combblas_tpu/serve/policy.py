@@ -43,6 +43,15 @@ class ReplicaDeadError(RuntimeError):
     honestly."""
 
 
+class StaleEpochError(RuntimeError):
+    """A slice was asked to advance a batch epoch it holds no resident
+    loop state for (it respawned or restarted mid-batch).  This is a
+    healthy slice reporting a protocol fact, NOT a death: the router
+    replays the whole batch under a fresh epoch (re-seeding every
+    slice) without quarantining anyone — the round-21 slice-resident
+    hop-state contract."""
+
+
 class ReplicaFleetBase:
     """Routing + supervision policy over ``self.replicas`` (anything
     with ``submit(kind, root, timeout_s=)`` returning a Future).

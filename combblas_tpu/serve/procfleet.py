@@ -59,7 +59,7 @@ from ..tuner import config as tuner_config
 from .batcher import settle
 from .faults import ProcessFaultPlan
 from .ipc import Channel, ChannelClosed
-from .policy import ReplicaDeadError, ReplicaFleetBase
+from .policy import ReplicaDeadError, ReplicaFleetBase, StaleEpochError
 from .scheduler import BackpressureError, ServeConfig
 
 #: Router-thread handoff for cross-process trace stitching (round 18):
@@ -87,11 +87,14 @@ class IpcTimeoutError(RuntimeError):
 
 #: Child-error name -> parent exception class (the retry/spillover
 #: taxonomy must survive the wire: BackpressureError spills,
-#: ValueError/TimeoutError do NOT read-retry, anything else does).
+#: ValueError/TimeoutError do NOT read-retry, StaleEpochError replays
+#: the sharded batch WITHOUT quarantining the slice, anything else
+#: does retry).
 _EXC_TYPES = {
     "BackpressureError": BackpressureError,
     "ValueError": ValueError,
     "TimeoutError": TimeoutError,
+    "StaleEpochError": StaleEpochError,
 }
 
 

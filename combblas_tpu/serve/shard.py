@@ -30,12 +30,28 @@ serves the union as ONE engine:
   (their per-row combines — SELECT2ND_MAX, min — are
   order-independent, so the slab bucket layout cannot change them).
 
-Hops are STATELESS: all loop state (frontier, parents/levels,
-distances, the propagate indicator block) lives at the router as
-``[n, W]`` host arrays and each hop RPC is a pure function of its
-inputs.  A slice that dies mid-batch fails the hop future; the router
-heals the slice (see below) and replays the whole batch — idempotent
-by construction.
+The hop datapath (round 21) is the CombBLAS SpMSpV stance applied at
+the wire: slab-local loop state (bfs ``parents``/``levels``, the sssp
+resident global ``d``, propagate's last slab ``q``) stays DEVICE-
+RESIDENT on its slice across the hops of one batch, keyed by a
+per-batch epoch token the router mints, and only the live frontier
+crosses the wire — as dtype-minimized ``SparseFrontier`` triples when
+it is sparse, falling back to the dense ``[n, W]`` operand per hop
+when it crosses the density threshold (the diropt regime switch,
+decided by the ROUTER and stamped in the payload — never a trace-time
+branch; ``COMBBLAS_SHARD_FRONTIER`` forces either encoding).  The
+sparse frontier scatters into the dense operand ON DEVICE through a
+pow2-bucketed static-capacity scatter prologue (every bucket
+pre-traced at warmup — zero post-warmup retraces under every
+encoding), and the final gather fetches slab state ONCE at batch end
+(``collect``) instead of every hop.  Replay stays idempotent: a slice
+that dies mid-batch fails the hop future, the router heals it and
+replays the whole batch under a FRESH epoch (re-seeding resident
+state everywhere); a respawned slice that is asked to advance an
+epoch it never saw answers ``StaleEpochError`` — a protocol fact,
+not a death — and the router replays without quarantining it.
+Propagate's inherently-dense ``q`` can opt into bf16 wire encoding
+(``COMBBLAS_SHARD_WIRE=bf16``, quantization error obs-tracked).
 
 Durability is ENGINE-OWNED (``owns_durability``): writes route
 through per-slice WALs with a coordinated two-phase protocol —
@@ -84,9 +100,11 @@ import numpy as np
 from .. import obs
 from ..dynamic import wal as dyn_wal
 from ..dynamic.delta import DeltaBatch
+from ..tuner import config as tuner_config
 from ..utils import checkpoint as ckpt
+from .frame import SparseFrontier, pack_bf16, unpack_bf16
 from .ipc import Channel
-from .policy import ReplicaDeadError
+from .policy import ReplicaDeadError, StaleEpochError
 from .procfleet import IpcTimeoutError, ReplicaProc
 
 #: Manifest schema tag (refused at recovery when mismatched — the
@@ -102,6 +120,66 @@ FEATURES_NAME = "features.npy"
 #: normalization / backward sweeps that do not decompose into the
 #: stateless row-slab hop protocol — they stay on unsharded engines.
 SHARDED_KINDS = ("bfs", "sssp", "propagate")
+
+#: Smallest sparse-scatter capacity bucket: frontiers pad UP to a pow2
+#: capacity so every bucket is exactly one trace; 64 keeps the bucket
+#: count logarithmic without wasting wire on tiny frontiers (padding
+#: is ADDED slice-side before the device scatter, never shipped).
+SCATTER_CAP_FLOOR = 64
+
+
+def _pow2_cap(nnz: int, floor: int = SCATTER_CAP_FLOOR) -> int:
+    """The pow2 scatter-capacity bucket for ``nnz`` frontier triples."""
+    cap = int(floor)
+    while cap < nnz:
+        cap <<= 1
+    return cap
+
+
+def _pad_triples(sf: SparseFrontier, cap: int, n: int):
+    """Pad triple arrays to the pow2 capacity with OUT-OF-RANGE rows
+    (``row == n``): the device scatter runs ``mode='drop'``, so pad
+    entries vanish without a mask operand — one trace per bucket, any
+    nnz inside it."""
+    pad = cap - sf.nnz
+    rows = np.concatenate([sf.rows, np.full(pad, n, np.int32)])
+    lanes = np.concatenate([
+        sf.lanes.astype(np.int32), np.zeros(pad, np.int32)
+    ])
+    vals = None if sf.vals is None else np.concatenate([
+        sf.vals, np.zeros(pad, np.float32)
+    ])
+    return rows, lanes, vals
+
+
+def _payload_nbytes(obj) -> int:
+    """Logical wire bytes of a hop payload/reply: the array payloads
+    that dominate the frame (JSON header overhead excluded — it is
+    O(100 B) against KB..MB of state)."""
+    if isinstance(obj, np.ndarray):
+        return obj.nbytes
+    if isinstance(obj, SparseFrontier):
+        return obj.nbytes()
+    if isinstance(obj, dict):
+        return sum(_payload_nbytes(v) for v in obj.values())
+    if isinstance(obj, (list, tuple)):
+        return sum(_payload_nbytes(v) for v in obj)
+    return 0
+
+
+def _pack_q_wire(q: np.ndarray, wire: str | None) -> dict:
+    """Encode a dense float payload for the wire: raw f32, or bf16
+    halved-width uint16 when the router stamped ``wire=bf16``."""
+    if wire == "bf16":
+        return {"q": pack_bf16(q), "wire": "bf16"}
+    return {"q": np.asarray(q, np.float32), "wire": "f32"}
+
+
+def _unpack_q(m: dict) -> np.ndarray:
+    q = m["q"]
+    if m.get("wire") == "bf16":
+        return unpack_bf16(q)
+    return np.asarray(q, np.float32)
 
 
 # --------------------------------------------------------------------------
@@ -170,6 +248,7 @@ class _SlicePlan:
     kind: str
     width: int
     fn: object
+    scatter: object = None   # jitted sparse-frontier scatter prologue
     traces: int = 0
     executions: int = 0
 
@@ -208,6 +287,10 @@ class SliceRuntime:
         self.wal = dyn_wal.open_wal(home, fsync=fsync) \
             if home is not None else None
         self._plans: dict = {}
+        # per-kind slice-resident loop state, keyed by the router's
+        # batch epoch (round 21): parents/levels slabs (bfs), the
+        # global d operand (sssp), the last hop's q slab (propagate)
+        self._resident: dict = {}
         self._lock = threading.Lock()
         self.plan_hits = 0
         self.plan_misses = 0
@@ -438,12 +521,32 @@ class SliceRuntime:
                 x_next = jnp.where(
                     new, row_gids[:, :, None], jnp.int32(-1)
                 )
-                return pb[0], lb[0], x_next[0], jnp.any(new)
+                # no any_new output: the host derives activity from the
+                # discovered nnz it extracts for the wire anyway
+                return pb[0], lb[0], x_next[0]
 
             jitted = jax.jit(impl)
             plan.fn = lambda x, p, l, level: jitted(
                 self.version.E, self._slab_row_gids(), x, p, l, level
             )
+
+            def scatter_impl(rows, lanes):
+                # sparse-frontier prologue: pow2-capacity triple
+                # arrays scattered into the dense [n, W] operand the
+                # hop body consumes.  Pad rows are OUT OF RANGE
+                # (== n) and vanish under mode='drop' — one trace per
+                # capacity bucket, any nnz inside it.  Flattened to a
+                # rank-1 scatter (pad index lands >= n*width, still
+                # dropped): one index dim keeps XLA:CPU on its fast
+                # path, ~25% cheaper at saturated-hop capacities.
+                trace_mark()
+                x = jnp.full((n * width,), jnp.int32(-1))
+                idx = rows * width + lanes
+                return x.at[idx].set(rows, mode="drop").reshape(
+                    n, width
+                )
+
+            plan.scatter = jax.jit(scatter_impl)
 
         elif kind == "sssp":
 
@@ -454,10 +557,27 @@ class SliceRuntime:
                 relaxed = dist_spmv_ell_multi(MIN_PLUS, E, mkcol(d))
                 db = d[row0:row1]
                 nb = jnp.minimum(db, relaxed.blocks[0])
-                return nb, jnp.any(nb != db)
+                # changed MASK (not a reduced flag): the host extracts
+                # exactly the relaxed entries for the sparse wire
+                return nb, nb < db
 
             jitted = jax.jit(impl)
             plan.fn = lambda d: jitted(self._sssp_operand(), d)
+
+            def scatter_impl(d, rows, lanes, vals):
+                # scatter-MIN of inbound relaxations into the resident
+                # global d (min is idempotent + commutative, so a
+                # slice's own broadcast entries fold in harmlessly);
+                # rank-1 indexing for the same XLA:CPU fast path as
+                # the bfs prologue
+                trace_mark()
+                w = d.shape[1]
+                idx = rows * w + lanes
+                return d.reshape(-1).at[idx].min(
+                    vals, mode="drop"
+                ).reshape(d.shape)
+
+            plan.scatter = jax.jit(scatter_impl)
 
         elif kind == "propagate":
             if self.X is None:
@@ -499,6 +619,72 @@ class SliceRuntime:
         Ew = self.version.E_weighted
         return Ew if Ew is not None else self.version.E
 
+    # -- slice-resident loop state (round 21) ------------------------------
+
+    def _resident_for(self, kind: str, epoch: int, m: dict, W: int):
+        """The resident loop state for this batch epoch.  A ``seed``
+        payload (the batch's first fan, or a replay's) re-creates it
+        from the payload; otherwise an epoch mismatch means this slice
+        respawned mid-batch and holds nothing — a PROTOCOL fact, not a
+        death, reported as :class:`StaleEpochError` so the router
+        replays the whole batch without quarantining anyone."""
+        if m.get("seed"):
+            st = self._seed_resident(kind, epoch, m, W)
+            self._resident[kind] = st
+            return st
+        st = self._resident.get(kind)
+        if st is None or st.epoch != epoch:
+            have = ("no resident state" if st is None
+                    else f"epoch {st.epoch}")
+            raise StaleEpochError(
+                f"slice {self.idx} asked to advance {kind} epoch "
+                f"{epoch} but holds {have} (respawned mid-batch?)"
+            )
+        return st
+
+    def _seed_resident(self, kind: str, epoch: int, m: dict, W: int):
+        import jax.numpy as jnp
+
+        if kind == "bfs":
+            parents = np.full((self.ls, W), -1, np.int32)
+            levels = np.full((self.ls, W), -1, np.int32)
+            if "xs" in m:
+                sf = m["xs"]
+                rows = sf.rows.astype(np.int64)
+                keep = (rows >= self.row0) & (rows < self.row1)
+                rr = rows[keep] - self.row0
+                ll = sf.lanes[keep].astype(np.int64)
+                parents[rr, ll] = rows[keep]   # source: self-parent
+                levels[rr, ll] = 0
+            else:
+                slab = np.asarray(m["x"],
+                                  np.int32)[self.row0:self.row1]
+                rr, ll = np.nonzero(slab >= 0)
+                parents[rr, ll] = slab[rr, ll]
+                levels[rr, ll] = 0
+            return SimpleNamespace(epoch=epoch,
+                                   parents=jnp.asarray(parents),
+                                   levels=jnp.asarray(levels))
+        if kind == "sssp":
+            if "ds" in m:
+                sf = m["ds"]
+                d = np.full((self.ncols, W), np.inf, np.float32)
+                d[sf.rows, sf.lanes.astype(np.int64)] = sf.vals
+            else:
+                d = np.asarray(m["d"], np.float32)
+            return SimpleNamespace(epoch=epoch, d=jnp.asarray(d))
+        return SimpleNamespace(epoch=epoch, q_slab=None)
+
+    def _bfs_x_operand(self, plan: _SlicePlan, m: dict):
+        import jax.numpy as jnp
+
+        if "x" in m:
+            return jnp.asarray(np.asarray(m["x"], np.int32))
+        sf = m["xs"]
+        rows, lanes, _ = _pad_triples(sf, _pow2_cap(sf.nnz),
+                                      self.ncols)
+        return plan.scatter(rows, lanes)
+
     # -- the hop surface (one bulk-synchronous step) ----------------------
 
     def hop(self, kind: str, m: dict) -> dict:
@@ -507,86 +693,177 @@ class SliceRuntime:
 
         W = int(m["width"])
         plan = self.plan(kind, W)
+        epoch = int(m.get("epoch", 0))
+        sparse = m.get("enc") == "sparse"
         t0 = time.perf_counter()
         if kind == "bfs":
-            p, l, x_next, any_new = plan.fn(
-                jnp.asarray(np.asarray(m["x"], np.int32)),
-                jnp.asarray(np.asarray(m["parents"], np.int32)),
-                jnp.asarray(np.asarray(m["levels"], np.int32)),
-                jnp.int32(int(m["level"])),
+            st = self._resident_for(kind, epoch, m, W)
+            x = self._bfs_x_operand(plan, m)
+            pb, lb, x_next = plan.fn(
+                x, st.parents, st.levels, jnp.int32(int(m["level"]))
             )
             plan.executions += 1
-            out = {
-                "parents": np.asarray(jax.device_get(p)),
-                "levels": np.asarray(jax.device_get(l)),
-                "x": np.asarray(jax.device_get(x_next)),
-                "any": bool(any_new),
-            }
-        elif kind == "sssp":
-            nd, changed = plan.fn(
-                jnp.asarray(np.asarray(m["d"], np.float32))
-            )
-            plan.executions += 1
-            out = {
-                "d": np.asarray(jax.device_get(nd)),
-                "any": bool(changed),
-            }
-        elif kind == "propagate":
-            if m.get("final"):
-                part = plan.fn.fini(
-                    jnp.asarray(np.asarray(m["q"], np.float32))
+            st.parents, st.levels = pb, lb
+            xh = np.asarray(jax.device_get(x_next))
+            # outbound discovery extraction is slab-LOCAL (a D2H of
+            # [ls, W] then nonzero) — never shipped dense when the
+            # router asked for triples
+            rr, ll = np.nonzero(xh >= 0)
+            out = {"any": bool(rr.size), "nnz": int(rr.size)}
+            if sparse:
+                out["xs"] = SparseFrontier(
+                    self.ncols, W, rr.astype(np.int64) + self.row0, ll
                 )
+            else:
+                out["x"] = xh
+        elif kind == "sssp":
+            st = self._resident_for(kind, epoch, m, W)
+            if not m.get("seed"):
+                # fold the broadcast relaxations (own included —
+                # scatter-MIN is idempotent) into the resident d
+                if "ds" in m:
+                    sf = m["ds"]
+                    if sf.nnz:
+                        rows, lanes, vals = _pad_triples(
+                            sf, _pow2_cap(sf.nnz), self.ncols
+                        )
+                        st.d = plan.scatter(st.d, rows, lanes, vals)
+                elif "d" in m:
+                    st.d = jnp.asarray(np.asarray(m["d"], np.float32))
+            nb, ch = plan.fn(st.d)
+            plan.executions += 1
+            nbh = np.asarray(jax.device_get(nb))
+            chh = np.asarray(jax.device_get(ch))
+            rr, ll = np.nonzero(chh)
+            out = {"any": bool(rr.size), "nnz": int(rr.size)}
+            if sparse:
+                out["ds"] = SparseFrontier(
+                    self.ncols, W, rr.astype(np.int64) + self.row0,
+                    ll, nbh[rr, ll]
+                )
+            else:
+                out["d"] = nbh
+        elif kind == "propagate":
+            st = self._resident_for(kind, epoch, m, W)
+            if m.get("final"):
+                if st.q_slab is None:
+                    # hops==0 edge: the seed rides the final payload
+                    st.q_slab = jnp.asarray(
+                        _unpack_q(m)[self.row0:self.row1]
+                    )
+                part = plan.fn.fini(st.q_slab)
                 plan.executions += 1
                 out = {"partial": np.asarray(jax.device_get(part))}
+                self._resident.pop(kind, None)
             else:
-                q = plan.fn.hop(
-                    jnp.asarray(np.asarray(m["q"], np.float32))
-                )
+                q = jnp.asarray(_unpack_q(m))
+                qs = plan.fn.hop(q)
                 plan.executions += 1
-                out = {"q": np.asarray(jax.device_get(q))}
+                # resident q_slab stays EXACT f32 on device for fini;
+                # only the wire copy is (optionally) bf16
+                st.q_slab = qs
+                out = _pack_q_wire(
+                    np.asarray(jax.device_get(qs)), m.get("wire")
+                )
         else:
             raise ValueError(f"unsupported sharded kind {kind!r}")
         obs.observe("serve.shard.hop_s", time.perf_counter() - t0,
                     kind=kind, slice=self.idx)
         return out
 
-    def warmup(self, kinds=None, widths=None) -> dict:
-        """Pre-trace every (kind, width) hop program on an inert
-        all-pad step (empty frontier / all-inf distances / zero
-        indicator) — after this, serving inside the warmed set
-        performs ZERO traces (asserted over IPC by the bench)."""
+    def collect(self, kind: str, m: dict) -> dict:
+        """Fetch the batch's FINAL slab state once, after the hop loop
+        converges (round 21) — replaces the per-hop dense state
+        round-trips of round 20.  Pops the resident entry: a hop under
+        the same epoch afterwards is a protocol bug and correctly
+        raises :class:`StaleEpochError`."""
         import jax
 
+        epoch = int(m.get("epoch", 0))
+        st = self._resident.get(kind)
+        if st is None or st.epoch != epoch:
+            have = ("no resident state" if st is None
+                    else f"epoch {st.epoch}")
+            raise StaleEpochError(
+                f"slice {self.idx} asked to collect {kind} epoch "
+                f"{epoch} but holds {have}"
+            )
+        self._resident.pop(kind, None)
+        if kind == "bfs":
+            return {
+                "parents": np.asarray(jax.device_get(st.parents)),
+                "levels": np.asarray(jax.device_get(st.levels)),
+            }
+        if kind == "sssp":
+            d = np.asarray(jax.device_get(st.d))
+            return {"d": d[self.row0:self.row1]}
+        raise ValueError(f"kind {kind!r} holds no collectable state")
+
+    def _scatter_caps(self, width: int) -> list:
+        """Every pow2 scatter-capacity bucket a frontier of up to
+        ``ncols * width`` triples can land in."""
+        caps = []
+        cap = SCATTER_CAP_FLOOR
+        top = _pow2_cap(self.ncols * int(width))
+        while cap <= top:
+            caps.append(cap)
+            cap <<= 1
+        return caps
+
+    def warmup(self, kinds=None, widths=None) -> dict:
+        """Pre-trace every (kind, width) hop program AND every pow2
+        scatter-capacity bucket on inert all-pad steps (empty frontier
+        / all-inf distances / zero indicator) — after this, serving
+        inside the warmed set performs ZERO traces under ANY encoding
+        (asserted over IPC by the bench)."""
         kinds = self.kinds if kinds is None else tuple(kinds)
         widths = (1, 2, 4, 8, 16) if widths is None else tuple(widths)
         out = {}
         for kind in kinds:
             for w in sorted(set(int(x) for x in widths)):
                 t0 = time.perf_counter()
+                plan = self.plan(kind, w)
                 if kind == "bfs":
-                    r = self.hop(kind, {
-                        "width": w,
-                        "x": np.full((self.ncols, w), -1, np.int32),
-                        "parents": np.full((self.ls, w), -1, np.int32),
-                        "levels": np.full((self.ls, w), -1, np.int32),
-                        "level": 0,
+                    self.hop(kind, {
+                        "width": w, "epoch": 0, "seed": True,
+                        "enc": "sparse", "level": 0,
+                        "xs": SparseFrontier(
+                            self.ncols, w, np.zeros(0, np.int32),
+                            np.zeros(0, np.uint8),
+                        ),
                     })
+                    for cap in self._scatter_caps(w):
+                        plan.scatter(
+                            np.full(cap, self.ncols, np.int32),
+                            np.zeros(cap, np.int32),
+                        )
                 elif kind == "sssp":
-                    r = self.hop(kind, {
-                        "width": w,
-                        "d": np.full((self.ncols, w), np.inf,
-                                     np.float32),
+                    self.hop(kind, {
+                        "width": w, "epoch": 0, "seed": True,
+                        "enc": "sparse",
+                        "ds": SparseFrontier(
+                            self.ncols, w, np.zeros(0, np.int32),
+                            np.zeros(0, np.uint8),
+                            np.zeros(0, np.float32),
+                        ),
                     })
+                    st = self._resident[kind]
+                    for cap in self._scatter_caps(w):
+                        st.d = plan.scatter(
+                            st.d,
+                            np.full(cap, self.ncols, np.int32),
+                            np.zeros(cap, np.int32),
+                            np.zeros(cap, np.float32),
+                        )
                 else:
                     q = np.zeros((self.ncols, w), np.float32)
-                    self.hop(kind, {"width": w, "q": q})
-                    r = self.hop(kind, {
-                        "width": w, "final": True,
-                        "q": np.zeros((self.ls, w), np.float32),
-                    })
-                jax.block_until_ready  # results already host-side
-                del r
+                    self.hop(kind, {"width": w, "epoch": 0,
+                                    "seed": True, "q": q,
+                                    "wire": "f32"})
+                    self.hop(kind, {"width": w, "epoch": 0,
+                                    "final": True})
                 out[(kind, w)] = time.perf_counter() - t0
+        self._resident.clear()
         return out
 
     def trace_mark(self) -> int:
@@ -762,6 +1039,8 @@ def dispatch_slice_op(rt: SliceRuntime, op: str, m: dict):
     and the subprocess worker (one protocol, two transports)."""
     if op == "hop":
         return rt.hop(m["kind"], m)
+    if op == "collect":
+        return rt.collect(m["kind"], m)
     if op == "warmup":
         w = rt.warmup(kinds=m.get("kinds"), widths=m.get("widths"))
         return {f"{k}/{wd}": s for (k, wd), s in w.items()}
@@ -1047,6 +1326,9 @@ class ShardedEngine:
                  ipc_timeout_s: float = 60.0,
                  recover_wait_s: float = 30.0,
                  exec_retries: int = 3,
+                 frontier: str | None = None,
+                 density: float | None = None,
+                 wire: str | None = None,
                  factories=None):
         self.slices = list(slices)
         self.spec = spec
@@ -1060,6 +1342,14 @@ class ShardedEngine:
         self.ipc_timeout_s = float(ipc_timeout_s)
         self.recover_wait_s = float(recover_wait_s)
         self.exec_retries = int(exec_retries)
+        # round-21 wire-protocol knobs: the ENCODING IS A ROUTER
+        # DECISION stamped into every hop payload — slices never
+        # branch at trace time on it
+        self.frontier_mode = tuner_config.shard_frontier(frontier)
+        self.density_threshold = tuner_config.shard_density(density)
+        self.wire = tuner_config.shard_wire(wire)
+        self._epoch = 0
+        self.last_exec_stats: dict = {}
         self._factories = list(factories or [])
         self._exec_lock = threading.RLock()
         self._write_lock = threading.Lock()
@@ -1103,7 +1393,10 @@ class ShardedEngine:
               checkpoint_retain: int = 2,
               hb_interval_s: float = 0.25, hb_timeout_s: float = 3.0,
               ipc_timeout_s: float = 60.0,
-              recover_wait_s: float = 30.0) -> "ShardedEngine":
+              recover_wait_s: float = 30.0,
+              frontier: str | None = None,
+              density: float | None = None,
+              wire: str | None = None) -> "ShardedEngine":
         """Partition a global COO over ``nslices`` row slabs and boot
         one slice per slab (``mode="local"`` in-process — the tier-1
         representative; ``mode="process"`` real subprocesses).  The
@@ -1205,7 +1498,8 @@ class ShardedEngine:
                       else int(np.asarray(features).shape[1])),
             max_iters=max_iters, propagate_hops=propagate_hops,
             hb_timeout_s=hb_timeout_s, ipc_timeout_s=ipc_timeout_s,
-            recover_wait_s=recover_wait_s, factories=factories,
+            recover_wait_s=recover_wait_s, frontier=frontier,
+            density=density, wire=wire, factories=factories,
         )
         eng.mode = mode
         eng._write_manifest()
@@ -1216,7 +1510,10 @@ class ShardedEngine:
                 max_iters=None, hb_interval_s: float = 0.25,
                 hb_timeout_s: float = 3.0,
                 ipc_timeout_s: float = 60.0,
-                recover_wait_s: float = 30.0) -> "ShardedEngine":
+                recover_wait_s: float = 30.0,
+                frontier: str | None = None,
+                density: float | None = None,
+                wire: str | None = None) -> "ShardedEngine":
         """Reboot the whole service from its home: manifest → slice
         homes → per-slice snapshot + WAL-suffix replay.  Each slice
         recovers to ITS OWN frontier (the vector semantics); the
@@ -1271,7 +1568,8 @@ class ShardedEngine:
             max_iters=max_iters,
             propagate_hops=int(man.get("propagate_hops", 2)),
             hb_timeout_s=hb_timeout_s, ipc_timeout_s=ipc_timeout_s,
-            recover_wait_s=recover_wait_s, factories=factories,
+            recover_wait_s=recover_wait_s, frontier=frontier,
+            density=density, wire=wire, factories=factories,
         )
         eng.mode = mode
         return eng
@@ -1389,10 +1687,40 @@ class ShardedEngine:
 
     # -- execution (the router hop loop) ----------------------------------
 
+    def _mint_epoch(self) -> int:
+        """A fresh batch-attempt token (under the exec lock): every
+        hop of one attempt carries it, slices key their resident loop
+        state on it, and a replay gets a NEW one — so state left by a
+        failed attempt can never leak into its replay."""
+        self._epoch += 1
+        return self._epoch
+
+    def _choose_enc(self, nnz: int, W: int) -> str:
+        """The per-hop encoding decision (router-owned; slices obey
+        the stamped choice): triples win while the frontier is sparse,
+        the dense operand wins once scatter padding + triple overhead
+        pass the density threshold (the diropt precedent — a DATA
+        decision, never a trace-time branch)."""
+        if self.frontier_mode != "auto":
+            return self.frontier_mode
+        dense = self.spec.ncols * int(W)
+        return ("sparse"
+                if nnz <= self.density_threshold * dense else "dense")
+
+    def _pack_q_payload(self, q: np.ndarray) -> dict:
+        p = _pack_q_wire(q, self.wire)
+        if self.wire == "bf16":
+            err = (float(np.max(np.abs(unpack_bf16(p["q"]) - q)))
+                   if q.size else 0.0)
+            obs.observe("serve.shard.wire_quant_err", err)
+        return p
+
     def execute(self, kind: str, sources) -> dict:
         """One batch, bulk-synchronously across slices; on a slice
         failure mid-batch the whole batch replays after the heal
-        (hops are stateless and read-only — replay is idempotent)."""
+        (replay is idempotent: a fresh epoch re-seeds every slice's
+        resident state — including the respawned one's, which is how
+        a StaleEpochError report is resolved)."""
         last_exc = None
         for attempt in range(self.exec_retries + 1):
             if attempt:
@@ -1405,44 +1733,81 @@ class ShardedEngine:
                 ):
                     return self._execute_once(kind, sources)
             except (ReplicaDeadError, IpcTimeoutError,
-                    ConnectionError) as e:
+                    ConnectionError, StaleEpochError) as e:
                 last_exc = e
         raise RuntimeError(
             f"sharded {kind} batch failed after "
             f"{self.exec_retries + 1} attempts: {last_exc}"
         ) from last_exc
 
-    def _fan_hop(self, kind: str, per_slice_payload) -> list:
-        """One bulk-synchronous hop: RPC every slice in parallel,
-        gather in slice order; any failure quarantines the slice
-        (sticky — the supervisor respawns it) and raises."""
+    def _fan_hop(self, kind: str, per_slice_payload, *,
+                 op: str = "hop", enc: str | None = None,
+                 stats: dict | None = None) -> list:
+        """One bulk-synchronous fan (``hop`` or ``collect``): RPC
+        every slice in parallel, gather in slice order, account the
+        wire bytes both directions.  A transport/death failure
+        quarantines the slice (sticky — the supervisor respawns it)
+        and raises; a :class:`StaleEpochError` is a HEALTHY slice
+        reporting lost resident state — re-raised for a whole-batch
+        replay WITHOUT quarantining the reporter."""
+        t0 = time.perf_counter()
+        enc_label = enc if enc is not None else op
+        bytes_out = 0
         futs = []
         for i, sl in enumerate(self.slices):
+            payload = per_slice_payload(i)
+            bytes_out += _payload_nbytes(payload)
             try:
                 futs.append(sl.rpc(
-                    "hop", per_slice_payload(i),
-                    timeout_s=self.ipc_timeout_s,
+                    op, payload, timeout_s=self.ipc_timeout_s,
                 ))
             except Exception as e:
                 self._mark_dead(i, e)
                 raise
         results = []
         failed = None
+        stale = None
         for i, f in enumerate(futs):
             try:
                 results.append(f.result(
                     timeout=self.ipc_timeout_s + 5
                 ))
+            except StaleEpochError as e:
+                stale = stale or e
+                results.append(None)
             except Exception as e:
                 self._mark_dead(i, e)
                 failed = failed or e
                 results.append(None)
         if failed is not None:
+            # a real death outranks a stale report: heal first, the
+            # replay re-seeds everyone anyway
             if isinstance(failed, (ReplicaDeadError, IpcTimeoutError,
                                    ConnectionError)):
                 raise failed
             raise ReplicaDeadError(str(failed)) from failed
-        obs.count("serve.shard.hops", kind=kind)
+        if stale is not None:
+            obs.count("serve.shard.stale_epochs", kind=kind)
+            raise stale
+        bytes_in = sum(_payload_nbytes(r) for r in results)
+        obs.count("serve.shard.hop_bytes", bytes_out,
+                  direction="out", encoding=enc_label)
+        obs.count("serve.shard.hop_bytes", bytes_in,
+                  direction="in", encoding=enc_label)
+        if op == "hop":
+            obs.count("serve.shard.hops", kind=kind)
+            if enc in ("sparse", "dense"):
+                obs.count("serve.shard.encoding", choice=enc)
+        if stats is not None:
+            stats["hops" if op == "hop" else "collects"] += 1
+            stats["bytes_out"] += bytes_out
+            stats["bytes_in"] += bytes_in
+            by = stats["bytes_by_enc"]
+            by[enc_label] = by.get(enc_label, 0) + bytes_out + bytes_in
+            if op == "hop" and enc in ("sparse", "dense"):
+                eh = stats["enc_hops"]
+                eh[enc] = eh.get(enc, 0) + 1
+            stats["hop_wall_s"] += time.perf_counter() - t0
         return results
 
     def _execute_once(self, kind: str, sources) -> dict:
@@ -1451,10 +1816,20 @@ class ShardedEngine:
 
         W = int(sources.shape[0])
         n = self.nrows
+        nc = self.spec.ncols
         bounds = self.spec.bounds
         live = sources != PAD_ROOT
         lanes = np.arange(W)
         valid = live & (sources >= 0) & (sources < n)
+        epoch = self._mint_epoch()
+        stats = {
+            "kind": kind, "width": W, "epoch": epoch,
+            "hops": 0, "collects": 0,
+            "bytes_out": 0, "bytes_in": 0,
+            "bytes_by_enc": {}, "enc_hops": {},
+            "frontier_nnz": [], "hop_wall_s": 0.0,
+        }
+        self.last_exec_stats = stats
         if kind == "bfs":
             # the router-side mirror of _bfs_batch_impl's init + loop:
             # the step always runs at least once (active starts True);
@@ -1462,59 +1837,143 @@ class ShardedEngine:
             # level count is under the cap — identical niter semantics
             iters = self.max_iters if self.max_iters is not None \
                 else n
-            parents = np.full((n, W), -1, np.int32)
-            levels = np.full((n, W), -1, np.int32)
-            x = np.full((n, W), -1, np.int32)
-            parents[sources[valid], lanes[valid]] = sources[valid]
-            levels[sources[valid], lanes[valid]] = 0
-            x[sources[valid], lanes[valid]] = sources[valid]
+            sf = SparseFrontier(
+                nc, W, sources[valid], lanes[valid].astype(np.uint8)
+            )
             niter = 0
             active = True
+            seed = True
             while active and niter < iters:
-                res = self._fan_hop(kind, lambda i: {
-                    "kind": kind, "width": W, "x": x,
-                    "parents": parents[bounds[i][0]:bounds[i][1]],
-                    "levels": levels[bounds[i][0]:bounds[i][1]],
-                    "level": niter,
-                })
-                xs = []
-                for (r0, r1), r in zip(bounds, res):
-                    parents[r0:r1] = r["parents"]
-                    levels[r0:r1] = r["levels"]
-                    xs.append(r["x"])
-                x = np.concatenate(xs, axis=0)
+                enc = self._choose_enc(sf.nnz, W)
+                stats["frontier_nnz"].append(sf.nnz)
+                obs.observe("serve.shard.frontier_nnz", sf.nnz,
+                            kind=kind)
+                base = {"kind": kind, "width": W, "epoch": epoch,
+                        "level": niter, "enc": enc, "seed": seed}
+                if enc == "sparse":
+                    base["xs"] = sf
+                else:
+                    base["x"] = sf.to_dense(np.int32(-1))
+                res = self._fan_hop(kind, lambda i: base, enc=enc,
+                                    stats=stats)
+                seed = False
+                if enc == "sparse":
+                    sf = SparseFrontier(
+                        nc, W,
+                        np.concatenate([r["xs"].rows for r in res]),
+                        np.concatenate([r["xs"].lanes for r in res]),
+                    )
+                else:
+                    # dense replies are slabs in slice order — their
+                    # concatenation index IS the global row id, and a
+                    # discovered entry's value is its own row
+                    x = np.concatenate([r["x"] for r in res], axis=0)
+                    rr, ll = np.nonzero(x >= 0)
+                    sf = SparseFrontier(nc, W, rr, ll)
                 active = any(r["any"] for r in res)
                 niter += 1
+            if niter == 0:
+                # degenerate cap (max_iters=0): no hop ran, so no
+                # resident state exists to collect — seed-only result
+                parents = np.full((n, W), -1, np.int32)
+                levels = np.full((n, W), -1, np.int32)
+                parents[sources[valid], lanes[valid]] = sources[valid]
+                levels[sources[valid], lanes[valid]] = 0
+                return {"parents": parents, "levels": levels,
+                        "batch_niter": 0}
+            cres = self._fan_hop(
+                kind, lambda i: {"kind": kind, "epoch": epoch},
+                op="collect", stats=stats,
+            )
             return {
-                "parents": parents, "levels": levels,
+                "parents": np.concatenate(
+                    [r["parents"] for r in cres], axis=0
+                ),
+                "levels": np.concatenate(
+                    [r["levels"] for r in cres], axis=0
+                ),
                 "batch_niter": int(niter),
             }
         if kind == "sssp":
-            d = np.full((n, W), np.inf, np.float32)
+            # the router keeps a host mirror of d in EVERY encoding:
+            # triples fold in exactly (slabs are row-disjoint, min is
+            # monotone) and the mirror is what a dense-fallback hop
+            # broadcasts mid-loop
+            d = np.full((nc, W), np.inf, np.float32)
             d[sources[valid], lanes[valid]] = 0.0
+            sf = SparseFrontier(
+                nc, W, sources[valid], lanes[valid].astype(np.uint8),
+                np.zeros(int(valid.sum()), np.float32),
+            )
             niter = 0
             changed = True
+            seed = True
             while changed and niter < n:
-                res = self._fan_hop(kind, lambda i: {
-                    "kind": kind, "width": W, "d": d,
-                })
+                enc = self._choose_enc(sf.nnz, W)
+                stats["frontier_nnz"].append(sf.nnz)
+                obs.observe("serve.shard.frontier_nnz", sf.nnz,
+                            kind=kind)
+                base = {"kind": kind, "width": W, "epoch": epoch,
+                        "enc": enc, "seed": seed}
+                if enc == "sparse":
+                    base["ds"] = sf
+                else:
+                    base["d"] = d
+                res = self._fan_hop(kind, lambda i: base, enc=enc,
+                                    stats=stats)
+                seed = False
+                rows_l, lanes_l, vals_l = [], [], []
                 for (r0, r1), r in zip(bounds, res):
-                    d[r0:r1] = r["d"]
+                    if "ds" in r:
+                        s = r["ds"]
+                        d[s.rows, s.lanes.astype(np.int64)] = s.vals
+                        rows_l.append(s.rows)
+                        lanes_l.append(s.lanes)
+                        vals_l.append(s.vals)
+                    else:
+                        nb = r["d"]
+                        chg = nb < d[r0:r1]
+                        rr, ll = np.nonzero(chg)
+                        rows_l.append((rr + r0).astype(np.int32))
+                        lanes_l.append(ll.astype(np.uint8))
+                        vals_l.append(nb[rr, ll])
+                        d[r0:r1] = nb
+                sf = SparseFrontier(
+                    nc, W, np.concatenate(rows_l),
+                    np.concatenate(lanes_l), np.concatenate(vals_l),
+                )
                 changed = any(r["any"] for r in res)
                 niter += 1
-            return {"dist": d, "batch_niter": int(niter)}
+            if niter == 0:
+                return {"dist": d, "batch_niter": 0}
+            cres = self._fan_hop(
+                kind, lambda i: {"kind": kind, "epoch": epoch},
+                op="collect", stats=stats,
+            )
+            dist = np.concatenate([r["d"] for r in cres], axis=0)
+            return {"dist": dist, "batch_niter": int(niter)}
         if kind == "propagate":
-            q = np.zeros((n, W), np.float32)
+            q = np.zeros((nc, W), np.float32)
             q[sources[valid], lanes[valid]] = 1.0
+            seed = True
             for _ in range(max(self.propagate_hops, 0)):
-                res = self._fan_hop(kind, lambda i: {
-                    "kind": kind, "width": W, "q": q,
-                })
-                q = np.concatenate([r["q"] for r in res], axis=0)
-            res = self._fan_hop(kind, lambda i: {
-                "kind": kind, "width": W, "final": True,
-                "q": q[bounds[i][0]:bounds[i][1]],
-            })
+                base = {"kind": kind, "width": W, "epoch": epoch,
+                        "seed": seed, "enc": "dense"}
+                base.update(self._pack_q_payload(q))
+                res = self._fan_hop(kind, lambda i: base, enc="dense",
+                                    stats=stats)
+                seed = False
+                q = np.concatenate([_unpack_q(r) for r in res],
+                                   axis=0)
+            # the last hop's q slab is RESIDENT (exact f32) on each
+            # slice — the final fan ships no state, except the
+            # hops==0 edge where the seed rides the final payload
+            fin = {"kind": kind, "width": W, "epoch": epoch,
+                   "final": True, "seed": seed}
+            if seed:
+                fin.update(self._pack_q_payload(q))
+            res = self._fan_hop(kind, lambda i: fin, enc="final",
+                                stats=stats)
             # fixed slice-order summation: the float partials reduce
             # deterministically (run-to-run stable; vs the unsharded
             # single-dot program it is allclose, not bit-exact)
@@ -1856,6 +2315,10 @@ class ShardedEngine:
             "shard": {
                 "nslices": self.spec.nslices,
                 "bounds": [list(b) for b in self.spec.bounds],
+                "frontier_mode": self.frontier_mode,
+                "density_threshold": self.density_threshold,
+                "wire": self.wire,
+                "last_exec": dict(self.last_exec_stats),
                 "frontier": list(self._version.frontier),
                 "device_bytes_per_slice":
                     list(self._version.device_bytes_per_slice),

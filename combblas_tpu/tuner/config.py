@@ -136,6 +136,27 @@ ENV_BENCH_NET_RATE = "BENCH_NET_RATE"
 ENV_BENCH_NET_CONNS = "BENCH_NET_CONNS"
 ENV_BENCH_NET_SECONDS = "BENCH_NET_SECONDS"
 
+#: Round-21 knobs: the sharded hop wire protocol (docs/serving.md
+#: "Sharded hop wire protocol").  ``COMBBLAS_SHARD_FRONTIER`` picks
+#: the frontier encoding the router stamps on each bulk-synchronous
+#: hop: ``sparse`` (COO triples of the live frontier), ``dense`` (the
+#: r20 ``[n, W]`` operand), or ``auto`` (sparse until the frontier
+#: crosses the density threshold, then dense per hop — the diropt
+#: regime switch applied at the wire).  ``COMBBLAS_SHARD_DENSITY`` is
+#: that threshold as a frontier-nnz fraction of ``n*W`` (auto mode
+#: only).  ``COMBBLAS_SHARD_WIRE`` opts propagate's inherently-dense
+#: ``q`` into bf16 wire encoding (``f32`` | ``bf16``; the router
+#: obs-tracks the quantization error as
+#: ``serve.shard.wire_quant_err``).
+ENV_SHARD_FRONTIER = "COMBBLAS_SHARD_FRONTIER"
+ENV_SHARD_DENSITY = "COMBBLAS_SHARD_DENSITY"
+ENV_SHARD_WIRE = "COMBBLAS_SHARD_WIRE"
+
+#: Valid sharded frontier encodings / wire dtypes (vetted at the knob,
+#: the MERGE/WAL_FSYNC precedent).
+SHARD_FRONTIER_MODES = ("auto", "sparse", "dense")
+SHARD_WIRE_MODES = ("f32", "bf16")
+
 #: Round-13 knob: the SpGEMM combine-merge tier (sort | runs | hash) —
 #: how partial-product pieces (3D fiber pieces, 2D ESC stage chunks)
 #: fold into one compacted tile.  Resolution: arg > plan-store record
@@ -192,6 +213,15 @@ DEFAULT_NET_ACCEPT_BACKLOG = 128
 DEFAULT_BENCH_NET_RATE = 200.0
 DEFAULT_BENCH_NET_CONNS = 128
 DEFAULT_BENCH_NET_SECONDS = 8.0
+#: Sharded-wire defaults (round 21): adaptive frontier encoding with
+#: dense fallback once the live frontier fills a quarter of the
+#: ``[n, W]`` operand (past ~0.25 the per-entry triple overhead —
+#: 5-9 B vs 4 B — plus scatter work loses to the dense memcpy), and
+#: f32 on the wire (bf16 is the explicit opt-in: it halves propagate's
+#: hop bytes but trades bit-exactness for allclose).
+DEFAULT_SHARD_FRONTIER = "auto"
+DEFAULT_SHARD_DENSITY = 0.25
+DEFAULT_SHARD_WIRE = "f32"
 
 
 def _str_env(name: str) -> str | None:
@@ -520,6 +550,63 @@ def bench_net_seconds(given: float | str | None = None) -> float:
             f"got {v!r}"
         ) from None
     return DEFAULT_BENCH_NET_SECONDS if s == 0 else max(s, 0.1)
+
+
+def shard_frontier(given: str | None = None) -> str:
+    """Sharded hop frontier encoding: explicit argument >
+    ``COMBBLAS_SHARD_FRONTIER`` > ``auto``.  A bogus value raises
+    naming the knob (the WAL_FSYNC/MERGE vetting precedent) instead of
+    surfacing as a silently-dense wire."""
+    v = _str_env(ENV_SHARD_FRONTIER) if given is None else given
+    if v is None:
+        return DEFAULT_SHARD_FRONTIER
+    if v not in SHARD_FRONTIER_MODES:
+        raise ValueError(
+            f"{ENV_SHARD_FRONTIER} must be one of "
+            f"{'|'.join(SHARD_FRONTIER_MODES)}; got {v!r}"
+        )
+    return v
+
+
+def shard_density(given: float | str | None = None) -> float:
+    """Auto-mode dense-fallback threshold as a frontier-nnz fraction
+    of ``n*W``: explicit argument > ``COMBBLAS_SHARD_DENSITY`` > 0.25.
+    ``0``/unset = default; vetted to (0, 1] — a fraction above 1 can
+    never trigger and reads as a typo'd percentage."""
+    v = os.environ.get(ENV_SHARD_DENSITY) if given is None else given
+    if v is None or v == "":
+        return DEFAULT_SHARD_DENSITY
+    try:
+        f = float(v)
+    except (TypeError, ValueError):
+        raise ValueError(
+            f"{ENV_SHARD_DENSITY} must be a fraction in (0, 1]; "
+            f"got {v!r}"
+        ) from None
+    if f == 0:
+        return DEFAULT_SHARD_DENSITY
+    if not (0.0 < f <= 1.0):
+        raise ValueError(
+            f"{ENV_SHARD_DENSITY} must be a fraction in (0, 1]; "
+            f"got {v!r}"
+        )
+    return f
+
+
+def shard_wire(given: str | None = None) -> str:
+    """Sharded dense-payload wire dtype (propagate's ``q``): explicit
+    argument > ``COMBBLAS_SHARD_WIRE`` > ``f32``.  A bogus value
+    raises naming the knob instead of surfacing as a silent precision
+    downgrade."""
+    v = _str_env(ENV_SHARD_WIRE) if given is None else given
+    if v is None:
+        return DEFAULT_SHARD_WIRE
+    if v not in SHARD_WIRE_MODES:
+        raise ValueError(
+            f"{ENV_SHARD_WIRE} must be one of "
+            f"{'|'.join(SHARD_WIRE_MODES)}; got {v!r}"
+        )
+    return v
 
 
 def checkpoint_every(given: int | None = None) -> int:

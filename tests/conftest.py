@@ -75,6 +75,17 @@ os.environ["BENCH_NET_RATE"] = "0"
 os.environ["BENCH_NET_CONNS"] = "0"
 os.environ["BENCH_NET_SECONDS"] = "0"
 
+# Hermetic sharded wire-protocol knobs (round 21): an ambient
+# COMBBLAS_SHARD_FRONTIER would force every sharded test's hop
+# encoding (the equivalence sweep pins its own modes via build
+# arguments), an ambient density threshold would move auto's
+# crossover, and an ambient COMBBLAS_SHARD_WIRE=bf16 would quantize
+# the bit-exactness gates — pin the defaults (""/"0" = default per
+# the tuner/config convention).
+os.environ["COMBBLAS_SHARD_FRONTIER"] = ""
+os.environ["COMBBLAS_SHARD_DENSITY"] = "0"
+os.environ["COMBBLAS_SHARD_WIRE"] = ""
+
 # Hermetic trace sampling (round 15): an ambient
 # COMBBLAS_OBS_TRACE_SAMPLE would make every obs-enabled serve test
 # also record per-request traces (and their ``serve.trace.sampled``

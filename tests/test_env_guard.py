@@ -210,6 +210,46 @@ def test_net_knobs_centralized(monkeypatch):
         config.bench_net_rate("fast")
 
 
+def test_shard_wire_knobs_centralized(monkeypatch):
+    """The round-21 sharded wire-protocol knobs parse through
+    tuner/config with the shared conventions: unset/""/"0" = default,
+    explicit argument beats the env, the density fraction is vetted
+    to (0, 1], and a bogus value raises NAMING the knob."""
+    import pytest
+
+    from combblas_tpu.tuner import config
+
+    for name in (config.ENV_SHARD_FRONTIER, config.ENV_SHARD_DENSITY,
+                 config.ENV_SHARD_WIRE):
+        assert name.startswith("COMBBLAS_")
+    # conftest pins ""/"0" => defaults
+    assert config.shard_frontier() == config.DEFAULT_SHARD_FRONTIER
+    assert config.shard_frontier() == "auto"
+    assert config.shard_density() == config.DEFAULT_SHARD_DENSITY
+    assert config.shard_wire() == config.DEFAULT_SHARD_WIRE == "f32"
+    monkeypatch.setenv(config.ENV_SHARD_FRONTIER, "sparse")
+    monkeypatch.setenv(config.ENV_SHARD_DENSITY, "0.5")
+    monkeypatch.setenv(config.ENV_SHARD_WIRE, "bf16")
+    assert config.shard_frontier() == "sparse"
+    assert config.shard_density() == 0.5
+    assert config.shard_wire() == "bf16"
+    # explicit argument beats the env
+    assert config.shard_frontier("dense") == "dense"
+    assert config.shard_density(0.1) == 0.1
+    assert config.shard_wire("f32") == "f32"
+    # "0" falls through to the default (the bench-knob convention)
+    assert config.shard_density(0) == config.DEFAULT_SHARD_DENSITY
+    # vetting raises NAMING the knob
+    with pytest.raises(ValueError, match=config.ENV_SHARD_FRONTIER):
+        config.shard_frontier("csr")
+    with pytest.raises(ValueError, match=config.ENV_SHARD_DENSITY):
+        config.shard_density(1.5)
+    with pytest.raises(ValueError, match=config.ENV_SHARD_DENSITY):
+        config.shard_density("most")
+    with pytest.raises(ValueError, match=config.ENV_SHARD_WIRE):
+        config.shard_wire("fp8")
+
+
 def test_pool_fleet_knobs_centralized(monkeypatch):
     """The round-14 pool/fleet knobs parse through tuner/config with
     the shared conventions (unset/empty/"0" = default; explicit

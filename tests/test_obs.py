@@ -987,3 +987,67 @@ def test_fleetlog_jsonl_roundtrip(tmp_path):
     d = fl.describe()
     assert d["recorded"] == 7 and d["file_events"] == 5
     assert d["truncated"] and d["write_errors"] == 0
+
+
+def test_round21_shard_wire_counters_gated():
+    """ISSUE 19 satellite: the round-21 sharded wire-protocol series —
+    per-fan payload bytes by direction and encoding, per-hop frontier
+    nnz, the router's encoding decision — are emitted under obs and
+    cost NOTHING when disabled.  A tiny 2-slice LOCAL engine keeps the
+    gate tier-1 cheap (warmup=False: trace counters are someone else's
+    gate)."""
+    import numpy as np
+
+    from combblas_tpu.serve import ShardedEngine
+
+    n = 24
+    rng = np.random.default_rng(5)
+    rows = rng.integers(0, n, 90)
+    cols = rng.integers(0, n, 90)
+    srcs = np.array([0, 7], np.int32)
+
+    def exercise(tag):
+        eng = ShardedEngine.build(
+            rows, cols, nrows=n, nslices=2, kinds=("bfs",),
+            warmup=False, frontier="auto",
+        )
+        eng.execute("bfs", srcs)
+        eng.close()
+        return eng
+
+    assert not obs.ENABLED
+    exercise("off")
+    assert obs.registry.empty()  # disabled: zero bookkeeping
+
+    obs.enable(install_hooks=False)
+    try:
+        obs.reset()
+        eng = exercise("on")
+        st = eng.last_exec_stats
+        assert st["hops"] >= 1 and st["collects"] == 1
+        g = obs.registry.get_counter
+        # every fan accounts both directions; labels partition by
+        # encoding (sparse/dense frontier hops + the collect fan)
+        by_enc = {
+            e: g("serve.shard.hop_bytes", direction="out", encoding=e)
+            + g("serve.shard.hop_bytes", direction="in", encoding=e)
+            for e in ("sparse", "dense", "collect")
+        }
+        assert by_enc["collect"] > 0
+        assert sum(by_enc.values()) == st["bytes_out"] + st["bytes_in"]
+        assert by_enc == st["bytes_by_enc"] | {
+            e: 0 for e in by_enc if e not in st["bytes_by_enc"]
+        }
+        # the router's per-hop decision + frontier size distribution
+        assert sum(
+            g("serve.shard.encoding", choice=c)
+            for c in ("sparse", "dense")
+        ) == st["hops"]
+        h = obs.registry.get_histogram(
+            "serve.shard.frontier_nnz", kind="bfs"
+        )
+        assert h["count"] == st["hops"]
+        assert h["max"] == max(st["frontier_nnz"])
+    finally:
+        obs.disable()
+        obs.reset()

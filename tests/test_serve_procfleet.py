@@ -89,6 +89,95 @@ def test_ipc_channel_roundtrip_with_ndarrays():
     cb.close()
 
 
+def test_ipc_sparse_frontier_and_bf16_roundtrip():
+    """ISSUE 19: the ``__spf__`` typed envelope round-trips a
+    SparseFrontier (dtypes pinned: rows int32, lanes uint8, optional
+    vals f32) through the length-prefixed frame codec, the width
+    bound is enforced at construction, and the bf16 pack/unpack pair
+    is round-to-nearest-even with |err| <= 2^-8 relative."""
+    from combblas_tpu.serve.frame import (
+        SparseFrontier, pack_bf16, unpack_bf16,
+    )
+
+    a, b = socket.socketpair()
+    ca, cb = Channel(a), Channel(b)
+    sf = SparseFrontier(40, 3, np.array([1, 7, 39]),
+                        np.array([0, 2, 1]))
+    sfv = SparseFrontier(40, 3, np.array([5]), np.array([1]),
+                         np.array([0.25]))
+    ca.send({"id": 1, "ok": True, "result": {"xs": sf, "ds": sfv}})
+    got = cb.recv(timeout=5)["result"]
+    for orig, back in ((sf, got["xs"]), (sfv, got["ds"])):
+        assert isinstance(back, SparseFrontier)
+        assert (back.n, back.width, back.nnz) == (orig.n, orig.width,
+                                                  orig.nnz)
+        np.testing.assert_array_equal(back.rows, orig.rows)
+        assert back.rows.dtype == np.int32
+        np.testing.assert_array_equal(back.lanes, orig.lanes)
+        assert back.lanes.dtype == np.uint8
+    assert got["xs"].vals is None
+    np.testing.assert_array_equal(got["ds"].vals, [0.25])
+    assert got["ds"].vals.dtype == np.float32
+    # to_dense scatters (row, lane) -> value (row id when vals=None)
+    dense = got["xs"].to_dense(np.int32(-1))
+    assert dense.shape == (40, 3)
+    assert dense[7, 2] == 7 and dense[0, 0] == -1
+    assert got["xs"].nbytes() == 3 * (4 + 1)
+    ca.close()
+    cb.close()
+    with pytest.raises(ValueError, match="width"):
+        SparseFrontier(10, 257, np.zeros(0), np.zeros(0))
+    # bf16: round-to-nearest-even, exact on bf16-representable values
+    q = np.array([0.0, 1.0, -2.5, 3.140625, 1e-3, 7e4], np.float32)
+    back = unpack_bf16(pack_bf16(q))
+    np.testing.assert_array_equal(back[:4], q[:4])  # representable
+    assert np.all(np.abs(back - q) <= np.abs(q) * 2.0 ** -8)
+
+
+def test_ipc_send_survives_reader_poll_timeout():
+    """ISSUE 19 (send-stall fix): ``settimeout`` is socket-GLOBAL, so
+    a reader thread polling ``recv`` with a short tick must not
+    impose that tick on a concurrent send of a frame bigger than the
+    kernel socket buffer headed to a peer that is slow to drain (the
+    scale-12 boot payload scenario).  The chunked sender keeps
+    partial progress across ticks instead of dying with a spurious
+    'peer gone: timed out'."""
+    a, b = socket.socketpair()
+    ca, cb = Channel(a), Channel(b)
+    stop = threading.Event()
+
+    def _reader_ticks():
+        # the procfleet reader-loop shape: recv with a tiny poll tick,
+        # constantly resetting the socket timeout under the sender
+        while not stop.is_set():
+            try:
+                ca.recv(timeout=0.02)
+            except socket.timeout:
+                continue
+            except ChannelClosed:
+                return
+
+    t = threading.Thread(target=_reader_ticks, daemon=True)
+    t.start()
+    big = {"id": 1, "blob": np.arange(1 << 20, dtype=np.int64)}  # 8 MB
+    got: dict = {}
+
+    def _slow_drain():
+        time.sleep(1.0)  # peer busy "importing its runtime"
+        got.update(cb.recv(timeout=30))
+
+    d = threading.Thread(target=_slow_drain, daemon=True)
+    d.start()
+    ca.send(big)  # old sendall: ChannelClosed within one poll tick
+    d.join(timeout=30)
+    stop.set()
+    assert not d.is_alive()
+    np.testing.assert_array_equal(got["blob"], big["blob"])
+    ca.close()
+    cb.close()
+    t.join(timeout=5)
+
+
 def test_ipc_oversized_frame_refused():
     from combblas_tpu.serve import ipc
 

@@ -358,3 +358,178 @@ def test_spec_owner_of_rejects_out_of_range():
         spec.owner_of(10)
     with pytest.raises(ValueError):
         spec.owner_of(-1)
+
+
+# --- the round-21 wire protocol: encodings, resident state, epochs -----------
+
+
+def test_encoding_equivalence_fast_representative(tmp_path):
+    """ISSUE 19: THE fast representative of the wire-protocol sweep —
+    one 2-slice local engine answers bfs/sssp bit-exactly vs the
+    unsharded build under FORCED sparse, forced dense, and auto
+    encodings (the router's per-hop choice mixes regimes mid-batch),
+    and the per-execute wire accounting shows sparse strictly cheaper
+    than dense on hop payloads."""
+    rows, cols = _coo(11)
+    w = (np.random.default_rng(11).random(rows.shape[0])
+         .astype(np.float32) + 0.1)
+    grid = Grid.make(1, 1)
+    eng = GraphEngine.from_coo(grid, rows, cols, nrows=N, weights=w,
+                               kinds=("bfs", "sssp"))
+    sh = ShardedEngine.build(
+        rows, cols, nrows=N, nslices=2, weights=w,
+        kinds=("bfs", "sssp"), home=str(tmp_path / "enc"),
+        mode="local", warmup=False,
+    )
+    try:
+        srcs = np.array([0, 5, 17], np.int32)
+        refs = {k: eng.execute(k, srcs) for k in ("bfs", "sssp")}
+        hop_payload = {}
+        for mode in ("sparse", "dense", "auto"):
+            sh.frontier_mode = mode  # the router owns the decision
+            for kind, keys in (("bfs", ("parents", "levels")),
+                               ("sssp", ("dist",))):
+                got = sh.execute(kind, srcs)
+                for key in keys:
+                    np.testing.assert_array_equal(
+                        np.asarray(refs[kind][key]), got[key],
+                        err_msg=f"{kind}/{key} under {mode}",
+                    )
+                assert (int(got["batch_niter"])
+                        == int(refs[kind]["batch_niter"])), mode
+                st = sh.last_exec_stats
+                assert st["collects"] == 1
+                assert len(st["frontier_nnz"]) == st["hops"]
+                if mode in ("sparse", "dense"):
+                    assert set(st["enc_hops"]) == {mode}
+                    hop_payload[(kind, mode)] = st["bytes_by_enc"][mode]
+        # auto mixed regimes on this graph (frontier starts tiny,
+        # saturates mid-batch, then dries up)
+        assert set(sh.last_exec_stats["enc_hops"]) == {"sparse",
+                                                       "dense"}
+        for kind in ("bfs", "sssp"):
+            assert (hop_payload[(kind, "sparse")]
+                    < hop_payload[(kind, "dense")])
+    finally:
+        sh.close()
+
+
+def test_stale_epoch_replay_reseeds_resident_state(tmp_path):
+    """ISSUE 19: a slice that loses its resident loop state mid-batch
+    (amnesia respawn between hops) reports StaleEpochError — a
+    PROTOCOL fact from a healthy slice, not a death — and the router
+    replays the whole batch under a fresh epoch, re-seeding every
+    slice, WITHOUT quarantining the reporter.  The replayed answer is
+    bit-exact."""
+    from combblas_tpu.serve.policy import StaleEpochError
+
+    rows, cols = _coo(13)
+    grid = Grid.make(1, 1)
+    eng = GraphEngine.from_coo(grid, rows, cols, N, kinds=("bfs",))
+    sh = ShardedEngine.build(
+        rows, cols, nrows=N, nslices=2, kinds=("bfs",),
+        home=str(tmp_path / "stale"), mode="local", warmup=False,
+        frontier="sparse",
+    )
+    try:
+        srcs = np.array([0, 5, 17], np.int32)
+        epoch0 = sh._epoch
+        orig_fan = sh._fan_hop
+        state = {"fans": 0, "stale": 0}
+
+        def fan(kind, payload, **kw):
+            if kw.get("op", "hop") == "hop" and state["fans"] == 2:
+                # between hops 2 and 3: slice 0 respawns with no
+                # resident state (the mid-batch SIGKILL analog)
+                sh.slices[0].rt = sh.slices[0]._factory(recover=True)
+            state["fans"] += 1
+            try:
+                return orig_fan(kind, payload, **kw)
+            except StaleEpochError:
+                state["stale"] += 1
+                raise
+
+        sh._fan_hop = fan
+        got = sh.execute("bfs", srcs)
+        ref = eng.execute("bfs", srcs)
+        assert state["stale"] == 1
+        assert not sh._needs_rebuild  # reporter was NOT quarantined
+        # the replay ran under a FRESH epoch (failed attempt's state
+        # can never leak into it)
+        assert sh._epoch >= epoch0 + 2
+        np.testing.assert_array_equal(np.asarray(ref["parents"]),
+                                      got["parents"])
+        np.testing.assert_array_equal(np.asarray(ref["levels"]),
+                                      got["levels"])
+        assert int(got["batch_niter"]) == int(ref["batch_niter"])
+    finally:
+        sh._fan_hop = orig_fan
+        sh.close()
+
+
+@pytest.mark.slow
+def test_encoding_equivalence_sweep(tmp_path):
+    """ISSUE 19 (slow twin): the full encoding-equivalence property
+    sweep — kinds x widths {1, 4, 16} x {2, 3} slices, forced sparse
+    vs forced dense vs auto, all bit-exact vs unsharded (propagate
+    allclose, plus the opt-in bf16 wire within its quantization
+    budget and the hops==0 final-fan edge)."""
+    rng = np.random.default_rng(21)
+    n, m = 48, 300
+    r0 = rng.integers(0, n, m // 2)
+    c0 = rng.integers(0, n, m // 2)
+    rows = np.concatenate([r0, c0])   # symmetric: propagate-legal
+    cols = np.concatenate([c0, r0])
+    w = rng.random(rows.shape[0]).astype(np.float32) + 0.1
+    feats = rng.normal(size=(n, 5)).astype(np.float32)
+    grid = Grid.make(1, 1)
+    eng = GraphEngine.from_coo(
+        grid, rows, cols, nrows=n, weights=w, features=feats,
+        symmetric=True, kinds=("bfs", "sssp", "propagate"),
+    )
+    for nslices in (2, 3):
+        sh = ShardedEngine.build(
+            rows, cols, nrows=n, nslices=nslices, weights=w,
+            features=feats, symmetric=True,
+            kinds=("bfs", "sssp", "propagate"),
+            home=str(tmp_path / f"s{nslices}"), mode="local",
+            warmup=False,
+        )
+        try:
+            for width in (1, 4, 16):
+                srcs = rng.integers(0, n, width).astype(np.int32)
+                refs = {k: eng.execute(k, srcs)
+                        for k in ("bfs", "sssp", "propagate")}
+                for mode in ("sparse", "dense", "auto"):
+                    sh.frontier_mode = mode
+                    for kind, keys in (("bfs", ("parents", "levels")),
+                                       ("sssp", ("dist",))):
+                        got = sh.execute(kind, srcs)
+                        for key in keys:
+                            np.testing.assert_array_equal(
+                                np.asarray(refs[kind][key]), got[key],
+                                err_msg=f"{nslices}sl/{kind}/{key}"
+                                        f"/w{width}/{mode}",
+                            )
+                        assert (int(got["batch_niter"])
+                                == int(refs[kind]["batch_niter"]))
+                ref_f = np.asarray(refs["propagate"]["features"])
+                for wire in ("f32", "bf16"):
+                    sh.wire = wire
+                    got = sh.execute("propagate", srcs)
+                    tol = 1e-5 if wire == "f32" else 3e-2
+                    np.testing.assert_allclose(
+                        ref_f, got["features"], rtol=tol, atol=tol,
+                        err_msg=f"{nslices}sl/propagate/w{width}"
+                                f"/{wire}",
+                    )
+                sh.wire = "f32"
+            # hops==0 edge: the seed rides the final fan
+            sh.propagate_hops = 0
+            got = sh.execute("propagate",
+                             np.array([0, 1], np.int32))
+            assert got["features"].shape == (feats.shape[1], 2)
+            sh.propagate_hops = eng.propagate_hops \
+                if hasattr(eng, "propagate_hops") else 2
+        finally:
+            sh.close()
